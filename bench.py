@@ -1,0 +1,75 @@
+"""Round benchmark: Game-of-Life cell-updates/sec on the p46gun_big workload.
+
+Workload per the reference's scaling benchmark (`3-life/p46gun_big.cfg`):
+500x500 periodic torus, 10,000 steps, no intermediate saves = 2.5e9 cell
+updates. Baseline: best recorded MPI result, 1.937 s @ 27 ranks = 1.29e9
+cups (`6-cartesian/times.txt:27`, see BASELINE.md). The board content is a
+fixed-seed random soup — cups is content-independent for a dense stencil.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BASELINE_CUPS = 1.29e9
+NY = NX = 500
+STEPS = 10_000
+
+
+def main() -> int:
+    import jax
+
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.utils.config import config_from_board
+
+    rng = np.random.default_rng(46)  # p46 in spirit
+    board = (rng.random((NY, NX)) < 0.3).astype(np.uint8)
+
+    # Honesty gate: the timed impl must be bit-exact vs the host oracle.
+    cfg_check = config_from_board(board, steps=8, save_steps=0)
+    sim_check = LifeSim(cfg_check, layout="serial", impl="auto")
+    got = sim_check.run(save=False)
+    ref = board.copy()
+    for _ in range(8):
+        ref = life_step_numpy(ref)
+    if not np.array_equal(got, ref):
+        print(json.dumps({"metric": "life_cups_p46gun_big", "value": 0.0,
+                          "unit": "cell_updates_per_sec", "vs_baseline": 0.0,
+                          "error": "parity check failed"}))
+        return 1
+
+    cfg = config_from_board(board, steps=STEPS, save_steps=0)
+    sim = LifeSim(cfg, layout="serial", impl="auto")
+    # Warm-up compiles the exact stepper the timed loop uses (same instance,
+    # same static step count).
+    sim.warmup()
+
+    best = float("inf")
+    for _ in range(3):
+        sim.reset()
+        t0 = time.perf_counter()
+        sim.step(STEPS)
+        sim.collect()  # device_get: block_until_ready is a no-op on axon
+        best = min(best, time.perf_counter() - t0)
+
+    cups = NY * NX * STEPS / best
+    print(json.dumps({
+        "metric": "life_cups_p46gun_big",
+        "value": round(cups, 1),
+        "unit": "cell_updates_per_sec",
+        "vs_baseline": round(cups / BASELINE_CUPS, 2),
+        "elapsed_sec": round(best, 4),
+        "backend": jax.default_backend(),
+        "impl": sim.impl,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

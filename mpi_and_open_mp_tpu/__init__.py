@@ -1,0 +1,28 @@
+"""mpi_and_open_mp_tpu — a TPU-native distributed stencil/HPC framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the MPI
+coursework repo ``kekoveca/MPI-and-Open-MP``:
+
+* Conway's Game of Life on a periodic 2-D torus, distributed over a
+  ``jax.sharding.Mesh`` under 1-D row, 1-D column, and 2-D Cartesian
+  decompositions (reference: ``3-life/life_mpi.c``, ``4-life/life_mpi.c``,
+  ``6-cartesian/life_cart.c``) with ``lax.ppermute`` halo exchange instead of
+  blocking ``MPI_Send``/``MPI_Recv``.
+* Distributed trapezoidal quadrature with ``lax.psum`` reductions
+  (reference: ``1-integral/integral.c``).
+* A fabric latency/bandwidth micro-benchmark probing ICI/DCN via timed
+  collectives (reference: ``2-network-params/mpi_send_recv.c``).
+* The reference's measurement harness contracts: ``.cfg`` inputs,
+  elapsed-seconds stdout, VTK snapshots, ``times.txt`` accumulation.
+
+Subpackages
+-----------
+``ops``       compute kernels (jnp stencils, Pallas kernels, quadrature)
+``parallel``  device mesh topology, halo exchange, collectives, fabric probe
+``models``    full simulations wiring config -> sharded state -> run loop -> IO
+``utils``     config loading, VTK IO, timing, native-library bindings
+"""
+
+__version__ = "0.1.0"
+
+from mpi_and_open_mp_tpu.utils.config import LifeConfig, load_config  # noqa: F401

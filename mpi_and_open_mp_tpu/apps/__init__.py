@@ -1,0 +1,10 @@
+"""Command-line drivers — the reference's L3 ``main()`` layer.
+
+Each app keeps the reference's IO contract (positional ``.cfg``/N argument,
+bare elapsed-seconds on stdout so ``times.txt`` harnesses keep working) and
+adds a real argparse CLI for mesh/layout/impl selection:
+
+* ``python -m mpi_and_open_mp_tpu.apps.life <cfg>``      ≙ ``life_mpi`` / ``life_cart`` / ``life2d``
+* ``python -m mpi_and_open_mp_tpu.apps.integral <N>``    ≙ ``mpi_integral``
+* ``python -m mpi_and_open_mp_tpu.apps.pingpong``        ≙ ``mpi_send_recv``
+"""

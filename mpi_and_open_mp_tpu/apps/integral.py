@@ -1,0 +1,52 @@
+"""Quadrature driver CLI.
+
+Contract (reference ``1-integral/integral.c:9-60``): positional N, elapsed
+seconds on stdout. The value itself is printed only with ``--print-value``
+(the reference comments its value printf out, ``integral.c:27,44``). N is
+int64 — the reference's 32-bit ``atoi`` truncation (``integral.c:12``) is
+deliberately not reproduced; pass ``--truncate-32bit`` to mimic it when
+comparing against recorded reference timings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from mpi_and_open_mp_tpu.models.integral import Integral
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+from mpi_and_open_mp_tpu.utils.timing import append_times_txt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mpi_and_open_mp_tpu.apps.integral")
+    p.add_argument("n", type=int, help="number of trapezoids")
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--print-value", action="store_true")
+    p.add_argument("--truncate-32bit", action="store_true",
+                   help="reproduce the reference's unsigned-32-bit N overflow")
+    p.add_argument("--times-file", default=None)
+    args = p.parse_args(argv)
+
+    n = args.n
+    if args.truncate_32bit:
+        n = n % (1 << 32)
+    mesh = mesh_lib.make_mesh_1d(args.devices, axis="i") if args.devices else None
+    integral = Integral(n, mesh=mesh)
+    integral.compute()  # warm-up: compile outside the timed region
+
+    t0 = time.perf_counter()
+    value = integral.compute()
+    elapsed = time.perf_counter() - t0
+
+    print(f"{elapsed:.6f}")
+    if args.times_file:
+        append_times_txt(args.times_file, elapsed)
+    if args.print_value:
+        print(f"{value!r}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

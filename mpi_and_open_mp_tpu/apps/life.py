@@ -1,0 +1,93 @@
+"""Life driver CLI.
+
+Contract (reference ``3-life/life_mpi.c:38-72``): positional ``.cfg``,
+VTK snapshots under ``--outdir`` at the cfg's save cadence, and ONE line on
+stdout — elapsed wall seconds of the timed step loop — so the reference's
+``times.txt``/speedup-plot harness consumes TPU runs unchanged. The timer
+brackets the whole simulate loop (saves included), like the reference's
+``MPI_Wtime`` pair (``life_mpi.c:50,64``), but after a one-step compile
+warm-up so XLA compilation isn't billed as simulation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+from mpi_and_open_mp_tpu.models.life import IMPLS, LAYOUTS, LifeSim
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+from mpi_and_open_mp_tpu.utils.config import load_config
+from mpi_and_open_mp_tpu.utils.timing import append_times_txt
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_and_open_mp_tpu.apps.life",
+        description="Distributed Game of Life on a periodic torus (TPU backend)",
+    )
+    p.add_argument("cfg", help="board config file (steps/save_steps/nx ny/cells)")
+    p.add_argument("--layout", choices=LAYOUTS, default="row")
+    p.add_argument("--impl", choices=IMPLS, default="auto")
+    p.add_argument("--fuse-steps", type=int, default=1, metavar="K",
+                   help="halo depth: exchange once per K local steps")
+    p.add_argument("--mesh", metavar="PY,PX",
+                   help="explicit 2-D mesh shape (cart layout)")
+    p.add_argument("--devices", type=int, metavar="N",
+                   help="use only the first N devices (1-D layouts)")
+    p.add_argument("--outdir", default=None,
+                   help="write VTK snapshots here (default: no saves)")
+    p.add_argument("--times-file", default=None,
+                   help="append elapsed seconds to this file (times.txt contract)")
+    p.add_argument("--print-final-population", action="store_true")
+    return p
+
+
+def make_mesh(args):
+    if args.layout == "serial":
+        return None
+    if args.mesh:
+        py, px = (int(v) for v in args.mesh.split(","))
+        return mesh_lib.make_mesh_2d(py, px)
+    if args.devices:
+        axis = "x" if args.layout == "col" else "y"
+        if args.layout == "cart":
+            return mesh_lib.make_mesh_2d(*mesh_lib.dims_create(args.devices, 2))
+        return mesh_lib.make_mesh_1d(args.devices, axis=axis)
+    return None  # LifeSim default: all devices
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = load_config(args.cfg)
+    sim = LifeSim(
+        cfg,
+        layout=args.layout,
+        impl=args.impl,
+        mesh=make_mesh(args),
+        fuse_steps=args.fuse_steps,
+        outdir=args.outdir,
+    )
+    # Warm-up: compile every stepper run() will hit, on THIS instance (jit
+    # caches are per-instance and keyed on the static step count), so no
+    # XLA compilation lands inside the timed bracket.
+    sim.warmup()
+
+    t0 = time.perf_counter()
+    final = sim.run()  # collect() inside forces device completion
+    elapsed = time.perf_counter() - t0
+
+    print(f"{elapsed:.6f}")
+    if args.times_file:
+        append_times_txt(args.times_file, elapsed)
+    if args.print_final_population:
+        print(int(np.asarray(final).sum()), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""Fabric probe CLI.
+
+Contract (reference ``2-network-params/mpi_send_recv.c:36-39``): one
+``size,time`` CSV row per message size on stdout (µs per hop), consumable by
+the reference's ``plot.ipynb`` α+βn analysis. ``--fit`` additionally prints
+the fitted latency α (µs) and bandwidth 1/β (MB/s) to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from mpi_and_open_mp_tpu.parallel import fabric, mesh as mesh_lib
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mpi_and_open_mp_tpu.apps.pingpong")
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--reps", type=int, default=100)
+    p.add_argument("--max-power", type=int, default=6,
+                   help="probe sizes 10^0..10^k bytes (default 6)")
+    p.add_argument("--out", default=None, help="also write CSV here")
+    p.add_argument("--fit", action="store_true")
+    args = p.parse_args(argv)
+
+    mesh = mesh_lib.make_mesh_1d(args.devices, axis="i")
+    sizes = tuple(10**k for k in range(args.max_power + 1))
+    rows = fabric.sweep(mesh, sizes=sizes, reps=args.reps)
+
+    print("size,time")
+    for s, us in rows:
+        print(f"{s},{us:.6f}")
+    if args.out:
+        fabric.write_csv(args.out, rows)
+    if args.fit:
+        alpha, bw = fabric.fit_alpha_beta(rows)
+        print(f"alpha={alpha:.3f}us bandwidth={bw:.1f}MB/s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

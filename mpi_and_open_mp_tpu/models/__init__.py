@@ -1,0 +1,1 @@
+from mpi_and_open_mp_tpu.models.life import LifeSim  # noqa: F401

@@ -1,0 +1,8 @@
+from mpi_and_open_mp_tpu.ops.life_ops import (  # noqa: F401
+    life_rule,
+    life_step_numpy,
+    life_step_roll,
+    life_step_padded,
+    pad_x_wrap,
+    pad_y_wrap,
+)

@@ -1,0 +1,15 @@
+from mpi_and_open_mp_tpu.parallel.mesh import (  # noqa: F401
+    dims_create,
+    decomposition,
+    make_mesh_1d,
+    make_mesh_2d,
+    AXIS_X,
+    AXIS_Y,
+)
+from mpi_and_open_mp_tpu.parallel.halo import (  # noqa: F401
+    halo_pad_y,
+    halo_pad_x,
+    halo_pad_2d,
+    ring_perm,
+)
+from mpi_and_open_mp_tpu.parallel import fabric  # noqa: F401

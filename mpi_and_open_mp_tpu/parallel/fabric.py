@@ -1,0 +1,109 @@
+"""Fabric latency/bandwidth probe — the ICI/DCN analogue of the reference's
+MPI ping-pong benchmark (``/root/reference/2-network-params/mpi_send_recv.c``).
+
+The reference times 10⁵ blocking Send/Recv round trips between two ranks for
+message sizes 1..10⁶ B and prints ``size,half-RTT µs`` CSV rows
+(``mpi_send_recv.c:20-39``); the same binary at two placements (1 node vs 2
+nodes) characterises shared-memory vs NIC transport. Here the transport is
+the accelerator fabric: a timed ``lax.ppermute`` ring shift of an N-byte
+buffer over a mesh axis, ``reps`` rounds fused in one jitted ``fori_loop``
+(so dispatch overhead amortises exactly like the reference's tight loop).
+One hop of a ring permute is the ppermute analogue of a half round trip.
+
+The α+βn model fit (``plot.ipynb`` cells 5-6) lives in ``fit_alpha_beta``:
+α = latency intercept, 1/β = asymptotic bandwidth.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+from mpi_and_open_mp_tpu.parallel.halo import ring_perm
+
+# Message sizes in bytes: 10^0 .. 10^6, matching mpi_send_recv.c:22.
+DEFAULT_SIZES = tuple(10**k for k in range(7))
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "reps", "mesh"))
+def _ring_shift_loop(buf: jnp.ndarray, *, axis: str, reps: int, mesh: Mesh):
+    """``reps`` sequential one-hop ring shifts of each device's buffer."""
+
+    def shifted(b):
+        p = lax.axis_size(axis)
+        return lax.ppermute(b, axis, ring_perm(p, 1))
+
+    smapped = jax.shard_map(
+        lambda b: lax.fori_loop(0, reps, lambda _, x: shifted(x), b),
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return smapped(buf)
+
+
+def ping(mesh: Mesh, msg_bytes: int, reps: int = 100) -> float:
+    """Mean seconds per one-hop transfer of a ``msg_bytes`` buffer.
+
+    Each device holds its own ``msg_bytes`` payload (int8), so one round
+    moves ``msg_bytes`` over every link in parallel — the fabric analogue of
+    the reference's 2-rank half-RTT.
+    """
+    axis = next(iter(mesh.shape))
+    p = mesh.size
+    n = max(1, msg_bytes)
+    buf = jnp.zeros((p * n,), dtype=jnp.int8)
+    buf = jax.device_put(buf, NamedSharding(mesh, P(axis)))
+    # Warm-up: compile + first transfer.
+    jax.device_get(_ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh))
+    t0 = time.perf_counter()
+    out = _ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh)
+    # device_get, not block_until_ready: the latter is a no-op on some
+    # platforms (observed on the axon TPU tunnel).
+    np.asarray(jax.device_get(out[:1]))
+    elapsed = time.perf_counter() - t0
+    return elapsed / reps
+
+
+def sweep(
+    mesh: Mesh | None = None,
+    sizes: tuple[int, ...] = DEFAULT_SIZES,
+    reps: int = 100,
+) -> list[tuple[int, float]]:
+    """Probe each message size; returns ``(bytes, microseconds_per_hop)``
+    rows — the reference's CSV schema (``mpi_send_recv.c:38``)."""
+    if mesh is None:
+        mesh = mesh_lib.make_mesh_1d(axis="i")
+    return [(s, ping(mesh, s, reps) * 1e6) for s in sizes]
+
+
+def write_csv(path: str, rows: list[tuple[int, float]]) -> None:
+    """``size,time`` CSV compatible with the reference's ``out_*.csv`` files
+    consumed by its ``plot.ipynb`` analysis."""
+    with open(path, "w") as fd:
+        fd.write("size,time\n")
+        for s, us in rows:
+            fd.write(f"{s},{us:.6f}\n")
+
+
+def fit_alpha_beta(rows: list[tuple[int, float]]) -> tuple[float, float]:
+    """Linear model t = α + β·n over the probe rows (times in µs).
+
+    Returns ``(alpha_us, bandwidth_mb_s)`` — the latency intercept and the
+    1/β asymptotic bandwidth, as in the reference's ``plot.ipynb`` cell 5
+    ``np.polyfit(buffer_size, time, 1)`` fit.
+    """
+    sizes = np.array([r[0] for r in rows], dtype=np.float64)
+    times = np.array([r[1] for r in rows], dtype=np.float64)
+    beta, alpha = np.polyfit(sizes, times, 1)
+    bandwidth_mb_s = (1.0 / beta) if beta > 0 else float("inf")
+    return float(alpha), float(bandwidth_mb_s)

@@ -1,0 +1,3 @@
+from mpi_and_open_mp_tpu.utils.config import LifeConfig, load_config, save_config  # noqa: F401
+from mpi_and_open_mp_tpu.utils.vtk import write_vtk, read_vtk  # noqa: F401
+from mpi_and_open_mp_tpu.utils.timing import Timer  # noqa: F401

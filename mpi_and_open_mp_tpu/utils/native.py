@@ -1,0 +1,102 @@
+"""ctypes bindings to the native C++ runtime IO library (``native/``).
+
+The reference's runtime layer (config parsing + VTK serialisation,
+``/root/reference/3-life/life2d.c:52-102``) is compiled C; this framework
+keeps that layer native too: ``native/lifeio.cpp`` built as ``liblifeio.so``.
+Python falls back transparently when the library hasn't been built
+(``make -C native``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+_HERE = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_HERE, "native", "liblifeio.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("LIFE_TPU_NO_NATIVE"):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.lifeio_load_config.restype = ctypes.c_int
+    lib.lifeio_load_config.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_longlong),  # steps, save_steps, nx, ny, ncells
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),  # cells buffer
+    ]
+    lib.lifeio_free.restype = None
+    lib.lifeio_free.argtypes = [ctypes.POINTER(ctypes.c_longlong)]
+    lib.lifeio_write_vtk.restype = ctypes.c_int
+    lib.lifeio_write_vtk.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+    ]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _require():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            f"native lifeio library not built; run `make -C native` "
+            f"(expected at {_SO_PATH})"
+        )
+    return lib
+
+
+def load_config(path):
+    from mpi_and_open_mp_tpu.utils.config import LifeConfig
+
+    lib = _require()
+    header = (ctypes.c_longlong * 5)()
+    cells_ptr = ctypes.POINTER(ctypes.c_longlong)()
+    rc = lib.lifeio_load_config(
+        str(path).encode(), header, ctypes.byref(cells_ptr)
+    )
+    if rc != 0:
+        raise ValueError(f"{path}: native config parse failed (rc={rc})")
+    steps, save_steps, nx, ny, ncells = (int(v) for v in header)
+    try:
+        if ncells:
+            flat = np.ctypeslib.as_array(cells_ptr, shape=(ncells * 2,)).copy()
+            cells = flat.reshape(-1, 2)
+        else:
+            cells = np.zeros((0, 2), dtype=np.int64)
+    finally:
+        lib.lifeio_free(cells_ptr)
+    return LifeConfig(steps=steps, save_steps=save_steps, nx=nx, ny=ny, cells=cells)
+
+
+def write_vtk(path, board: np.ndarray) -> None:
+    lib = _require()
+    board = np.ascontiguousarray(board, dtype=np.int32)
+    ny, nx = board.shape
+    rc = lib.lifeio_write_vtk(
+        str(path).encode(),
+        board.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        nx,
+        ny,
+    )
+    if rc != 0:
+        raise OSError(f"{path}: native VTK write failed (rc={rc})")

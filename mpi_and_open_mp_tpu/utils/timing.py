@@ -1,0 +1,31 @@
+"""Wall-clock timing with the reference's measurement contract.
+
+The reference brackets its hot loop with ``MPI_Wtime`` and prints bare
+elapsed seconds from one rank (``/root/reference/3-life/life_mpi.c:50,64-67``).
+Here the equivalent is ``time.perf_counter`` around fully-materialised device
+work: callers must pass results through ``block_until_ready`` (JAX dispatch is
+async) before stopping the clock.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    """Context manager measuring wall seconds; ``.elapsed`` after exit."""
+
+    def __enter__(self) -> "Timer":
+        self.start = time.perf_counter()
+        self.elapsed = float("nan")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self.start
+
+
+def append_times_txt(path: str, seconds: float) -> None:
+    """Append one wall-clock entry, matching the ``gtime -o times.txt -a``
+    accumulation used by the reference launchers (``3-life/run_life.sh:5``)."""
+    with open(path, "a") as fd:
+        fd.write(f"{seconds:.3f}\n")

@@ -1,0 +1,102 @@
+// Native runtime IO for mpi_and_open_mp_tpu: config parsing + VTK writing.
+//
+// The reference's runtime layer is compiled C (cfg loader at
+// /root/reference/3-life/life2d.c:52-72, VTK writer at
+// 3-life/life_mpi.c:120-148); this framework keeps those host-side hot
+// paths native as well. Exposed as a plain C ABI for ctypes
+// (mpi_and_open_mp_tpu/utils/native.py). Built fresh for this project —
+// buffered IO instead of the reference's fscanf/fprintf-per-cell.
+//
+// Build: make -C native     (produces liblifeio.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// Parse a .cfg file: header[5] = {steps, save_steps, nx, ny, ncells};
+// *cells_out = malloc'd flat (i, j) pairs (2*ncells int64), owned by the
+// caller via lifeio_free. Returns 0 on success, negative error codes
+// otherwise (-1 open, -2 header, -3 dangling coordinate).
+int lifeio_load_config(const char *path, long long header[5],
+                       long long **cells_out) {
+    *cells_out = nullptr;
+    FILE *fd = std::fopen(path, "rb");
+    if (!fd) return -1;
+
+    std::fseek(fd, 0, SEEK_END);
+    long size = std::ftell(fd);
+    std::fseek(fd, 0, SEEK_SET);
+    std::string text(static_cast<size_t>(size), '\0');
+    size_t got = std::fread(text.data(), 1, static_cast<size_t>(size), fd);
+    std::fclose(fd);
+    text.resize(got);
+
+    std::vector<long long> tokens;
+    const char *s = text.c_str();
+    char *end = nullptr;
+    while (*s) {
+        while (*s == ' ' || *s == '\t' || *s == '\n' || *s == '\r') ++s;
+        if (!*s) break;
+        long long v = std::strtoll(s, &end, 10);
+        if (end == s) return -2;  // non-numeric garbage
+        tokens.push_back(v);
+        s = end;
+    }
+    if (tokens.size() < 4) return -2;
+    size_t ncoords = tokens.size() - 4;
+    if (ncoords % 2) return -3;
+
+    for (int k = 0; k < 4; ++k) header[k] = tokens[k];
+    long long ncells = static_cast<long long>(ncoords / 2);
+    header[4] = ncells;
+    if (ncells) {
+        auto *cells = static_cast<long long *>(
+            std::malloc(sizeof(long long) * ncoords));
+        if (!cells) return -4;
+        std::memcpy(cells, tokens.data() + 4, sizeof(long long) * ncoords);
+        *cells_out = cells;
+    }
+    return 0;
+}
+
+void lifeio_free(long long *p) { std::free(p); }
+
+// Write an ASCII VTK 3.0 STRUCTURED_POINTS snapshot of a (ny, nx) board
+// (row-major int32), format-compatible with the reference's output
+// (header fields as at 3-life/life_mpi.c:129-140). Single buffered write.
+int lifeio_write_vtk(const char *path, const int *board, long long nx,
+                     long long ny) {
+    std::string out;
+    out.reserve(static_cast<size_t>(nx * ny * 2 + 256));
+    char header[256];
+    std::snprintf(header, sizeof header,
+                  "# vtk DataFile Version 3.0\n"
+                  "Created by mpi_and_open_mp_tpu\n"
+                  "ASCII\n"
+                  "DATASET STRUCTURED_POINTS\n"
+                  "DIMENSIONS %lld %lld 1\n"
+                  "SPACING 1 1 0.0\n"
+                  "ORIGIN 0 0 0.0\n"
+                  "CELL_DATA %lld\n"
+                  "SCALARS life int 1\n"
+                  "LOOKUP_TABLE life_table\n",
+                  nx + 1, ny + 1, nx * ny);
+    out += header;
+    char num[24];
+    for (long long k = 0; k < nx * ny; ++k) {
+        int n = std::snprintf(num, sizeof num, "%d\n", board[k]);
+        out.append(num, static_cast<size_t>(n));
+    }
+    FILE *fd = std::fopen(path, "wb");
+    if (!fd) return -1;
+    size_t wrote = std::fwrite(out.data(), 1, out.size(), fd);
+    std::fclose(fd);
+    return wrote == out.size() ? 0 : -2;
+}
+
+}  // extern "C"

@@ -1,0 +1,46 @@
+"""Test harness: force JAX onto 8 virtual CPU devices.
+
+Mirrors the SURVEY §4 test strategy: "multi-node" behaviour is exercised
+without a TPU pod by running every sharded code path on a virtual 8-device
+CPU mesh (``--xla_force_host_platform_device_count``). This must run before
+any backend is initialised; the environment's sitecustomize pre-imports jax
+and pins ``jax_platforms`` to the TPU plugin, so we re-pin to cpu here
+(backends initialise lazily, so this is still early enough).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_virtual_mesh():
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)
+
+
+def random_board(rng, ny, nx, density=0.35):
+    return (rng.random((ny, nx)) < density).astype(np.uint8)
+
+
+@pytest.fixture
+def make_board(rng):
+    def _make(ny, nx, density=0.35):
+        return random_board(rng, ny, nx, density)
+
+    return _make

@@ -1,0 +1,74 @@
+"""CLI driver contracts: stdout formats, times.txt accumulation, VTK output."""
+
+import os
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.apps import integral as integral_app
+from mpi_and_open_mp_tpu.apps import life as life_app
+from mpi_and_open_mp_tpu.apps import pingpong as pingpong_app
+from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+from mpi_and_open_mp_tpu.utils.config import load_config_py
+from mpi_and_open_mp_tpu.utils.vtk import read_vtk
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def test_life_cli_stdout_contract(tmp_path, capsys):
+    cfg_path = os.path.join(FIXTURES, "glider_10x10.cfg")
+    outdir = tmp_path / "vtk"
+    times = tmp_path / "times.txt"
+    rc = life_app.main(
+        [cfg_path, "--layout", "row", "--impl", "roll",
+         "--outdir", str(outdir), "--times-file", str(times)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().split("\n")
+    assert len(out) == 1  # ONE line: bare elapsed seconds
+    float(out[0])
+    # times.txt got the same accumulation the reference launchers produce.
+    assert len(times.read_text().strip().split("\n")) == 1
+    # Snapshots at the cfg cadence, parity vs oracle.
+    cfg = load_config_py(cfg_path)
+    b = cfg.board()
+    for _ in range(25):
+        b = life_step_numpy(b)
+    np.testing.assert_array_equal(read_vtk(outdir / "life_000025.vtk"), b)
+
+
+def test_life_cli_mesh_flag(tmp_path, capsys):
+    rc = life_app.main(
+        [os.path.join(FIXTURES, "rpentomino_40x32.cfg"),
+         "--layout", "cart", "--mesh", "2,4", "--impl", "halo",
+         "--fuse-steps", "4"]
+    )
+    assert rc == 0
+    float(capsys.readouterr().out.strip())
+
+
+def test_integral_cli(capsys):
+    rc = integral_app.main(["100000", "--devices", "8", "--print-value"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    float(captured.out.strip())
+    assert "3.14" in captured.err
+
+
+def test_integral_cli_truncate_32bit(capsys):
+    rc = integral_app.main(["4294967297", "--truncate-32bit", "--devices", "1"])
+    assert rc == 0  # 2^32+1 -> 1 trapezoid after truncation
+
+
+def test_pingpong_cli(tmp_path, capsys):
+    out_csv = tmp_path / "out.csv"
+    rc = pingpong_app.main(
+        ["--devices", "2", "--reps", "2", "--max-power", "2",
+         "--out", str(out_csv), "--fit"]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    lines = captured.out.strip().split("\n")
+    assert lines[0] == "size,time"
+    assert len(lines) == 4  # header + sizes 1,10,100
+    assert "alpha=" in captured.err
+    assert out_csv.exists()

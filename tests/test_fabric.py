@@ -1,0 +1,38 @@
+"""Fabric probe: CSV schema, data movement correctness, and the α+β fit."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_and_open_mp_tpu.parallel import fabric, mesh as mesh_lib
+
+
+def test_ring_shift_moves_data():
+    mesh = mesh_lib.make_mesh_1d(8, axis="i")
+    buf = jnp.arange(8, dtype=jnp.int8)
+    buf = jax.device_put(buf, NamedSharding(mesh, P("i")))
+    out = fabric._ring_shift_loop(buf, axis="i", reps=3, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.roll(np.arange(8), 3))
+
+
+def test_sweep_schema_and_csv(tmp_path):
+    mesh = mesh_lib.make_mesh_1d(2, axis="i")
+    rows = fabric.sweep(mesh, sizes=(1, 10, 100), reps=3)
+    assert [s for s, _ in rows] == [1, 10, 100]
+    assert all(us > 0 for _, us in rows)
+    path = tmp_path / "out.csv"
+    fabric.write_csv(path, rows)
+    lines = path.read_text().strip().split("\n")
+    assert lines[0] == "size,time"
+    assert lines[1].startswith("1,")
+
+
+def test_fit_alpha_beta_recovers_model():
+    # Synthetic t = 2.5 + 0.001*n (alpha 2.5us, bandwidth 1000 MB/s).
+    rows = [(n, 2.5 + 0.001 * n) for n in (1, 10, 100, 1000, 10**4, 10**5, 10**6)]
+    alpha, bw = fabric.fit_alpha_beta(rows)
+    assert alpha == pytest.approx(2.5, rel=1e-6)
+    assert bw == pytest.approx(1000.0, rel=1e-6)

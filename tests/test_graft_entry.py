@@ -1,0 +1,26 @@
+"""Driver entry-point contracts."""
+
+import sys
+import os
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft
+
+from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+
+
+def test_entry_jittable_and_correct():
+    import jax
+
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    np.testing.assert_array_equal(
+        np.asarray(out), life_step_numpy(np.asarray(args[0]))
+    )
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)

@@ -1,0 +1,72 @@
+"""Quadrature parity: sharded psum sum vs serial vs closed form (π)."""
+
+import numpy as np
+import pytest
+
+from mpi_and_open_mp_tpu.models.integral import Integral
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+PI = float(np.pi)
+
+
+@pytest.mark.parametrize("n", [10, 1000, 100_000])
+def test_serial_converges_to_pi(n):
+    mesh = mesh_lib.make_mesh_1d(1, axis="i")
+    val = Integral(n, mesh=mesh).compute()
+    # Trapezoid error for sqrt(4-x^2) is dominated by the singular
+    # derivative at x=2: O(n^-1.5).
+    assert abs(val - PI) < max(5.0 * n**-1.5, 1e-5)
+
+
+@pytest.mark.parametrize("n", [1000, 12_345, 999_983])
+def test_sharded_matches_serial(n):
+    """8-way psum reduction == 1-device sum (the reference's star-reduce
+    parity, integral.c:39-43), modulo f32 summation order."""
+    serial = Integral(n, mesh=mesh_lib.make_mesh_1d(1, axis="i")).compute()
+    sharded = Integral(n, mesh=mesh_lib.make_mesh_1d(8, axis="i")).compute()
+    assert sharded == pytest.approx(serial, rel=2e-6)
+    assert abs(sharded - PI) < 1e-3
+
+
+def test_large_n_int64_no_truncation():
+    """N beyond 2^32 must not wrap (the reference's atoi quirk is fixed)."""
+    n = (1 << 32) + 7
+    integral = Integral(n)
+    assert integral.n == n
+
+
+def test_large_n_accuracy_kahan():
+    """At N=1e8 (763 chunks/device) the Kahan accumulator must hold the
+    result near f32 noise, not drift with chunk count."""
+    val = Integral(10**8, mesh=mesh_lib.make_mesh_1d(8, axis="i")).compute()
+    assert abs(val - PI) < 2e-5
+
+
+def test_warmup_and_reset_roundtrip(make_board=None):
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+    from mpi_and_open_mp_tpu.utils.config import config_from_board
+    import numpy as np
+
+    board = (np.random.default_rng(3).random((16, 16)) < 0.4).astype(np.uint8)
+    cfg = config_from_board(board, steps=7, save_steps=3)
+    sim = LifeSim(cfg, layout="row", impl="halo")
+    assert sim._segment_lengths() == [1, 3]
+    sim.warmup()
+    np.testing.assert_array_equal(sim.collect(), board)  # state untouched
+    sim.step(5)
+    sim.reset()
+    assert sim.step_count == 0
+    np.testing.assert_array_equal(sim.collect(), board)
+
+
+def test_invalid_n():
+    with pytest.raises(ValueError):
+        Integral(0)
+
+
+def test_custom_interval():
+    import jax.numpy as jnp
+
+    mesh = mesh_lib.make_mesh_1d(8, axis="i")
+    val = Integral(100_000, a=0.0, b=1.0, f=lambda x: x * x, mesh=mesh).compute()
+    assert val == pytest.approx(1.0 / 3.0, abs=1e-5)

@@ -1,0 +1,120 @@
+"""End-to-end LifeSim parity across layouts, impls, meshes, and fusion depths.
+
+Every sharded configuration must produce a board bit-identical to the NumPy
+oracle — the framework analogue of the reference's serial-vs-MPI VTK parity
+(SURVEY §4). Runs on the 8-virtual-CPU-device mesh from conftest.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_and_open_mp_tpu.models.life import LifeSim
+from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+from mpi_and_open_mp_tpu.utils.config import config_from_board, load_config_py
+from mpi_and_open_mp_tpu.utils.vtk import read_vtk
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def oracle_n(board, n):
+    b = np.asarray(board)
+    for _ in range(n):
+        b = life_step_numpy(b)
+    return b
+
+
+@pytest.mark.parametrize("layout", ["serial", "row", "col", "cart"])
+@pytest.mark.parametrize("impl", ["roll", "halo"])
+def test_parity_divisible_board(make_board, layout, impl):
+    if layout == "serial" and impl == "halo":
+        with pytest.raises(ValueError, match="sharded layout"):
+            LifeSim(config_from_board(make_board(8, 8), 1, 1),
+                    layout="serial", impl="halo")
+        return
+    board = make_board(48, 40)  # divides 8 (row), 8 (col), and 4x2 (cart)
+    cfg = config_from_board(board, steps=20, save_steps=1000)
+    sim = LifeSim(cfg, layout=layout, impl=impl)
+    sim.step(20)
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, 20))
+
+
+@pytest.mark.parametrize("layout", ["row", "col", "cart"])
+def test_parity_uneven_board_roll(make_board, layout):
+    """Non-divisible boards (the reference's last-rank-absorbs-remainder
+    case, 3-life/life_mpi.c:178-183) via the global roll step."""
+    board = make_board(50, 37)
+    cfg = config_from_board(board, steps=15, save_steps=1000)
+    sim = LifeSim(cfg, layout=layout, impl="roll")
+    sim.step(15)
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, 15))
+
+
+@pytest.mark.parametrize("fuse", [2, 3, 5])
+@pytest.mark.parametrize("layout", ["row", "col", "cart"])
+def test_parity_fused_halo_steps(make_board, layout, fuse):
+    """Depth-k halo fusion: k local steps per exchange, incl. a non-divisible
+    remainder round (17 = 3*5 + 2 etc.)."""
+    board = make_board(48, 40)
+    cfg = config_from_board(board, steps=17, save_steps=1000)
+    sim = LifeSim(cfg, layout=layout, impl="halo", fuse_steps=fuse)
+    sim.step(17)
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, 17))
+
+
+def test_parity_explicit_meshes(make_board):
+    board = make_board(48, 40)
+    for py, px in [(2, 4), (8, 1), (1, 8), (2, 2)]:
+        mesh = mesh_lib.make_mesh_2d(py, px)
+        cfg = config_from_board(board, steps=12, save_steps=1000)
+        sim = LifeSim(cfg, layout="cart", impl="halo", mesh=mesh)
+        sim.step(12)
+        np.testing.assert_array_equal(sim.collect(), oracle_n(board, 12))
+
+
+def test_auto_impl_selection(make_board):
+    cfg = config_from_board(make_board(48, 40), steps=4, save_steps=10)
+    assert LifeSim(cfg, layout="row", impl="auto").impl == "halo"
+    cfg2 = config_from_board(make_board(50, 37), steps=4, save_steps=10)
+    assert LifeSim(cfg2, layout="row", impl="auto").impl == "roll"
+    with pytest.raises(ValueError):
+        LifeSim(cfg2, layout="row", impl="halo")
+
+
+def test_glider_fixture_end_to_end(tmp_path):
+    """Full driver contract: cfg in, VTK snapshots out at the reference's
+    cadence (save at i % save_steps == 0, before stepping)."""
+    cfg = load_config_py(os.path.join(FIXTURES, "glider_10x10.cfg"))
+    outdir = tmp_path / "vtk"
+    sim = LifeSim(cfg, layout="serial", impl="roll", outdir=outdir)
+    final = sim.run(save=True)
+    saved = sorted(os.listdir(outdir))
+    assert saved == [f"life_{i:06d}.vtk" for i in (0, 25, 50, 75)]
+    # Glider on a 10x10 torus has period 40; after 100 steps it sits at
+    # the 60-step phase: shifted by (100//4) % 10 = 5 in both axes.
+    start = cfg.board()
+    np.testing.assert_array_equal(final, oracle_n(start, 100))
+    np.testing.assert_array_equal(
+        read_vtk(outdir / "life_000075.vtk"), oracle_n(start, 75)
+    )
+
+
+def test_rpentomino_fixture_all_layouts():
+    cfg = load_config_py(os.path.join(FIXTURES, "rpentomino_40x32.cfg"))
+    start = cfg.board()
+    expect = oracle_n(start, cfg.steps)
+    assert expect.sum() > 0  # r-pentomino is long-lived
+    for layout in ["row", "col", "cart"]:
+        sim = LifeSim(cfg, layout=layout, impl="auto")
+        got = sim.run(save=False)
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_empty_fixture():
+    cfg = load_config_py(os.path.join(FIXTURES, "empty_10x10.cfg"))
+    sim = LifeSim(cfg, layout="row", impl="roll")
+    assert sim.run(save=False).sum() == 0

@@ -1,0 +1,66 @@
+"""Native C++ IO library: build, bind, and parity with the Python fallback."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from mpi_and_open_mp_tpu.utils import native
+from mpi_and_open_mp_tpu.utils.config import load_config_py, save_config, config_from_board
+from mpi_and_open_mp_tpu.utils.vtk import read_vtk, write_vtk_py
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    rc = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native")], capture_output=True
+    )
+    if rc.returncode != 0 or not native.available():
+        pytest.skip("native toolchain unavailable")
+
+
+def test_native_load_matches_python():
+    for name in ("glider_10x10.cfg", "empty_10x10.cfg", "rpentomino_40x32.cfg"):
+        path = os.path.join(FIXTURES, name)
+        py = load_config_py(path)
+        nat = native.load_config(path)
+        assert (nat.steps, nat.save_steps, nat.nx, nat.ny) == (
+            py.steps, py.save_steps, py.nx, py.ny)
+        np.testing.assert_array_equal(nat.cells, py.cells)
+
+
+def test_native_load_errors(tmp_path):
+    bad = tmp_path / "bad.cfg"
+    bad.write_text("1\n2\n")
+    with pytest.raises(ValueError):
+        native.load_config(bad)
+    dangling = tmp_path / "dangling.cfg"
+    dangling.write_text("1\n1\n4 4\n3\n")
+    with pytest.raises(ValueError):
+        native.load_config(dangling)
+    with pytest.raises(ValueError):
+        native.load_config(tmp_path / "missing.cfg")
+
+
+def test_native_vtk_matches_python(tmp_path, make_board):
+    board = make_board(13, 21)
+    p_native = tmp_path / "native.vtk"
+    p_py = tmp_path / "py.vtk"
+    native.write_vtk(p_native, board.astype(np.int32))
+    write_vtk_py(p_py, board)
+    # Byte-identical output from both writers.
+    assert p_native.read_bytes() == p_py.read_bytes()
+    np.testing.assert_array_equal(read_vtk(p_native), board)
+
+
+def test_native_roundtrip_config(tmp_path, make_board):
+    board = make_board(9, 9)
+    cfg = config_from_board(board, 7, 3)
+    path = tmp_path / "rt.cfg"
+    save_config(path, cfg)
+    nat = native.load_config(path)
+    np.testing.assert_array_equal(nat.board(), board)

@@ -1,0 +1,77 @@
+"""Config parsing, VTK IO, decomposition, and dims_create semantics."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpi_and_open_mp_tpu.parallel.mesh import decomposition, dims_create
+from mpi_and_open_mp_tpu.utils.config import (
+    config_from_board,
+    load_config_py,
+    save_config,
+)
+from mpi_and_open_mp_tpu.utils.vtk import read_vtk, write_vtk_py
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def test_load_glider():
+    cfg = load_config_py(os.path.join(FIXTURES, "glider_10x10.cfg"))
+    assert (cfg.steps, cfg.save_steps, cfg.nx, cfg.ny) == (100, 25, 10, 10)
+    board = cfg.board()
+    assert board.shape == (10, 10)
+    assert board.sum() == 5
+    # (i, j) -> board[j, i]
+    assert board[2, 0] == 1 and board[0, 1] == 1
+
+
+def test_load_empty():
+    cfg = load_config_py(os.path.join(FIXTURES, "empty_10x10.cfg"))
+    assert cfg.cells.shape == (0, 2)
+    assert cfg.board().sum() == 0
+
+
+def test_config_roundtrip(tmp_path, make_board):
+    board = make_board(12, 7)
+    cfg = config_from_board(board, steps=42, save_steps=6)
+    path = tmp_path / "rt.cfg"
+    save_config(path, cfg)
+    cfg2 = load_config_py(path)
+    assert (cfg2.steps, cfg2.save_steps, cfg2.nx, cfg2.ny) == (42, 6, 7, 12)
+    np.testing.assert_array_equal(cfg2.board(), board)
+
+
+def test_vtk_roundtrip(tmp_path, make_board):
+    board = make_board(9, 14)
+    path = tmp_path / "life_000000.vtk"
+    write_vtk_py(path, board)
+    np.testing.assert_array_equal(read_vtk(path), board)
+    text = path.read_text()
+    assert "DIMENSIONS 15 10 1" in text
+    assert f"CELL_DATA {9 * 14}" in text
+
+
+@pytest.mark.parametrize("n,p", [(500, 8), (10, 3), (28, 28), (7, 2), (100, 1)])
+def test_decomposition_reference_semantics(n, p):
+    """Floor chunks, last shard absorbs the remainder (3-life/life_mpi.c:178-183)."""
+    spans = [decomposition(n, p, k) for k in range(p)]
+    chunk = n // p
+    for k, (start, stop) in enumerate(spans):
+        assert start == k * chunk
+        if k < p - 1:
+            assert stop - start == chunk
+    assert spans[-1][1] == n
+    # Exact cover, no overlap.
+    covered = sorted(i for s, e in spans for i in range(s, e))
+    assert covered == list(range(n))
+
+
+@pytest.mark.parametrize(
+    "n,expect",
+    [(1, (1, 1)), (4, (2, 2)), (8, (4, 2)), (12, (4, 3)), (7, (7, 1)), (36, (6, 6))],
+)
+def test_dims_create(n, expect):
+    dims = dims_create(n, 2)
+    assert dims == expect
+    assert dims[0] * dims[1] == n
