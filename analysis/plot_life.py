@@ -1,0 +1,68 @@
+"""Speedup plot from a ``times.txt`` sweep.
+
+Script form of the reference's ``plot_life.py`` analysis
+(``/root/reference/3-life/plot_life.py:4-17``): line k of ``times.txt`` is
+the wall time at k devices/ranks; the plot is the speedup ``T1/TN`` as a
+scatter plus dashed line, saved to ``life_accel.png``. Works on reference-
+produced and TPU-produced times files alike (the CLI keeps the format).
+
+Usage: ``python analysis/plot_life.py [times.txt] [out.png]``
+"""
+
+from __future__ import annotations
+
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def load_times(path: str) -> np.ndarray:
+    vals = []
+    with open(path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                vals.append(float(line))
+            except ValueError:
+                # The reference's times files can contain gtime error lines
+                # ("Command exited with non-zero status 1"); skip them.
+                continue
+    return np.array(vals)
+
+
+def plot_speedup(times: np.ndarray, out: str) -> None:
+    n = np.arange(1, len(times) + 1)
+    speedup = times[0] / times
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.scatter(n, speedup, zorder=3)
+    ax.plot(n, speedup, linestyle="--", zorder=2)
+    ax.plot(n, n, color="gray", linewidth=0.8, label="ideal")
+    ax.set_xlabel("devices")
+    ax.set_ylabel("speedup $T_1/T_N$")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    times_path = argv[0] if argv else "times.txt"
+    out = argv[1] if len(argv) > 1 else "life_accel.png"
+    times = load_times(times_path)
+    if len(times) == 0:
+        print(f"{times_path}: no parsable times", file=sys.stderr)
+        return 1
+    plot_speedup(times, out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

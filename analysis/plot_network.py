@@ -1,0 +1,68 @@
+"""Fabric probe analysis: log-log latency/bandwidth plots + α+βn fit.
+
+Script form of the reference's ``2-network-params/plot.ipynb`` (cells 1-6):
+reads one or more ``size,time`` CSVs (µs per hop), renders time and
+bandwidth vs message size on log-log axes, and prints the linear-model fit
+α (latency intercept, µs) and 1/β (asymptotic bandwidth, MB/s) per file.
+
+Usage: ``python analysis/plot_network.py out_single.csv [out_mult.csv ...]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_and_open_mp_tpu.parallel.fabric import fit_alpha_beta  # noqa: E402
+
+
+def load_csv(path: str) -> list[tuple[int, float]]:
+    rows = []
+    with open(path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line or line.startswith("size"):
+                continue
+            s, t = line.split(",")
+            rows.append((int(s), float(t)))
+    return rows
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: plot_network.py probe.csv [...]", file=sys.stderr)
+        return 1
+    fig, (ax_t, ax_bw) = plt.subplots(1, 2, figsize=(11, 4.5))
+    for path in argv:
+        rows = load_csv(path)
+        sizes = np.array([r[0] for r in rows], dtype=float)
+        times = np.array([r[1] for r in rows], dtype=float)
+        label = os.path.basename(path)
+        ax_t.loglog(sizes, times, marker="o", label=label)
+        ax_bw.loglog(sizes, sizes / times, marker="o", label=label)
+        alpha, bw = fit_alpha_beta(rows)
+        print(f"{label}: alpha={alpha:.3f}us bandwidth={bw:.1f}MB/s")
+    ax_t.set_xlabel("message size [B]")
+    ax_t.set_ylabel("time per hop [µs]")
+    ax_bw.set_xlabel("message size [B]")
+    ax_bw.set_ylabel("bandwidth [MB/s]")
+    for ax in (ax_t, ax_bw):
+        ax.grid(True, which="both", alpha=0.3)
+        ax.legend()
+    fig.tight_layout()
+    fig.savefig("network_params.png", dpi=120)
+    print("network_params.png")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Generate the benchmark/fixture config suite (deterministic).
+
+The reference ships a graded set of ``.cfg`` workloads (SURVEY C18): an
+empty board, a glider, a small still-life mix, a big oscillator, a gun with
+per-step saves, and the headline ``p46gun_big`` scaling config. This script
+writes this framework's own equivalents (fresh patterns, same file format
+and roles). Run: ``python configs/make_configs.py``.
+"""
+
+import os
+
+import numpy as np
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_and_open_mp_tpu.utils.config import LifeConfig, save_config
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+GLIDER = [(0, 2), (1, 0), (1, 2), (2, 1), (2, 2)]
+
+# Gosper glider gun (36 cells, period 30) — the classic public pattern;
+# plays the reference's p46 Twin-bees-shuttle role of a growing workload.
+GOSPER_GUN = [
+    (0, 4), (0, 5), (1, 4), (1, 5),
+    (10, 4), (10, 5), (10, 6), (11, 3), (11, 7), (12, 2), (12, 8),
+    (13, 2), (13, 8), (14, 5), (15, 3), (15, 7), (16, 4), (16, 5),
+    (16, 6), (17, 5),
+    (20, 2), (20, 3), (20, 4), (21, 2), (21, 3), (21, 4), (22, 1),
+    (22, 5), (24, 0), (24, 1), (24, 5), (24, 6),
+    (34, 2), (34, 3), (35, 2), (35, 3),
+]
+
+# Pulsar (period 3, 48 cells) — one 12-cell quadrant reflected 4 ways:
+# horizontal triples at dy in {1, 6}, vertical triples at dx in {1, 6}.
+_PULSAR_QUAD = [
+    (2, 1), (3, 1), (4, 1), (2, 6), (3, 6), (4, 6),
+    (1, 2), (1, 3), (1, 4), (6, 2), (6, 3), (6, 4),
+]
+
+
+def pulsar_cells(cx: int, cy: int):
+    cells = set()
+    for dx, dy in _PULSAR_QUAD:
+        for sx in (1, -1):
+            for sy in (1, -1):
+                cells.add((cx + sx * dx, cy + sy * dy))
+    return sorted(cells)
+
+
+def offset(cells, dx, dy):
+    return [(i + dx, j + dy) for i, j in cells]
+
+
+def write(name, steps, save_steps, nx, ny, cells):
+    cfg = LifeConfig(steps, save_steps, nx, ny,
+                     np.array(sorted(set(cells)), dtype=np.int64).reshape(-1, 2)
+                     if cells else np.zeros((0, 2), dtype=np.int64))
+    save_config(os.path.join(HERE, name), cfg)
+    print(f"{name}: {nx}x{ny}, {steps} steps, {len(cfg.cells)} cells")
+
+
+def main():
+    # Empty smoke board (role of test.cfg).
+    write("test_10x10.cfg", 100, 1, 10, 10, [])
+    # Glider on a small torus (periodic-boundary exerciser).
+    write("glider_10x10.cfg", 100, 1, 10, 10, GLIDER)
+    # Small mixed still-lifes/oscillators on 40x20 (role of conf1.cfg):
+    # block, beehive, blinker, glider.
+    mix = ([(2, 2), (3, 2), (2, 3), (3, 3)]              # block
+           + [(10, 3), (11, 2), (12, 2), (13, 3), (12, 4), (11, 4)]  # beehive
+           + [(20, 10), (21, 10), (22, 10)]               # blinker
+           + offset(GLIDER, 28, 12))
+    write("mix_40x20.cfg", 100, 10, 40, 20, mix)
+    # Big oscillator field: 8x8 pulsars tiled on 500x500 (role of big_osc).
+    cells = []
+    for ty in range(8):
+        for tx in range(8):
+            cells += pulsar_cells(60 + tx * 48, 60 + ty * 48)
+    write("pulsar_field_500x500.cfg", 50, 10, 500, 500, cells)
+    # Gun with per-step saves (role of p46gun.cfg).
+    write("gun_300x100.cfg", 1000, 1, 300, 100, offset(GOSPER_GUN, 20, 40))
+    # Headline scaling benchmark (role of p46gun_big.cfg): 500x500, 10k
+    # steps, saves disabled. Content: the gun plus a deterministic soup so
+    # the board stays lively at full density.
+    rng = np.random.default_rng(46)
+    soup = np.argwhere(rng.random((500, 500)) < 0.3)  # (j, i) pairs
+    soup_cells = [(int(i), int(j)) for j, i in soup]
+    write("gun_big_500x500.cfg", 10000, 999999, 500, 500,
+          offset(GOSPER_GUN, 20, 240) + soup_cells)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Local Life scaling sweep — the TPU-era analogue of the reference's
+# run_life.sh (sweep np=1..12, append wall seconds to times.txt, plot with
+# plot_life.py). Same contract: one bare-seconds line per device count in
+# times.txt; analysis/plot_life.py consumes the result unchanged.
+#
+# Usage:
+#   launchers/run_life.sh [--backend=tpu|mpi] [--cfg=FILE] [--max-dev=N]
+#                         [--layout=row|col|cart] [--virtual]
+#                         [--times-file=FILE]
+#
+#   --backend=tpu  (default) run this framework's CLI, sweeping device count
+#                  1..max-dev over the real devices. Pass --virtual to run
+#                  the sweep on virtual CPU devices instead (required on a
+#                  single-chip host when max-dev > 1).
+#   --backend=mpi  run the original MPI reference binary via mpirun, if
+#                  MPI_LIFE_BIN points at a built binary and mpirun exists
+#                  (kept for side-by-side baselines; this repo does not
+#                  ship the MPI build).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BACKEND=tpu
+CFG=configs/gun_big_500x500.cfg
+MAXDEV=8
+LAYOUT=row
+VIRTUAL=0
+TIMES=times.txt
+for arg in "$@"; do
+  case "$arg" in
+    --backend=*)    BACKEND="${arg#*=}" ;;
+    --cfg=*)        CFG="${arg#*=}" ;;
+    --max-dev=*)    MAXDEV="${arg#*=}" ;;
+    --layout=*)     LAYOUT="${arg#*=}" ;;
+    --virtual)      VIRTUAL=1 ;;
+    --times-file=*) TIMES="${arg#*=}" ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+if [[ "$BACKEND" == mpi ]]; then
+  : "${MPI_LIFE_BIN:?--backend=mpi needs MPI_LIFE_BIN=/path/to/life_mpi}"
+  command -v mpirun >/dev/null || { echo "mpirun not found" >&2; exit 3; }
+  for np in $(seq 1 "$MAXDEV"); do
+    /usr/bin/time -f %e -o "$TIMES" -a \
+      mpirun -np "$np" --map-by :OVERSUBSCRIBE "$MPI_LIFE_BIN" "$CFG"
+  done
+  exit 0
+fi
+
+for np in $(seq 1 "$MAXDEV"); do
+  VFLAG=()
+  if [[ "$VIRTUAL" == 1 ]]; then
+    VFLAG=(--virtual-devices "$np")
+  fi
+  python -m mpi_and_open_mp_tpu.apps.life "$CFG" --layout "$LAYOUT" \
+    "${VFLAG[@]}" --devices "$np" --times-file "$TIMES"
+done
+echo "wrote $TIMES; plot with: python analysis/plot_life.py $TIMES"

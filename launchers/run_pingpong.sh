@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Fabric probe at two placements (reference job_single.sh vs job_mult.sh:
+# shared-memory vs NIC transport). Here the two interesting placements are
+# the single-chip loopback and the full mesh over ICI; multi-host pods add
+# a DCN row. Writes out_single.csv / out_mesh.csv for analysis/plot_network.py.
+#
+# Usage: launchers/run_pingpong.sh [--virtual]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VFLAG=()
+if [[ "${1:-}" == --virtual ]]; then
+  VFLAG=(--virtual-devices 8)
+fi
+
+python -m mpi_and_open_mp_tpu.apps.pingpong "${VFLAG[@]}" --devices 1 \
+  --out out_single.csv --fit
+python -m mpi_and_open_mp_tpu.apps.pingpong "${VFLAG[@]}" \
+  --out out_mesh.csv --fit
+echo "plot with: python analysis/plot_network.py out_single.csv out_mesh.csv"

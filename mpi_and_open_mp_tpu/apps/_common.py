@@ -1,0 +1,43 @@
+"""Shared CLI plumbing: platform selection and multi-host bootstrap.
+
+The reference's process bootstrap is ``MPI_Init`` under ``mpirun``
+(``0-intro/hello_world.c:8``); here it splits into two knobs:
+
+* ``--distributed``: ``jax.distributed.initialize()`` — multi-host pod
+  bootstrap, coordinator/rank discovered from the environment the way
+  ``mpirun``/PBS exported ranks for the reference (``job_life.sh:2-8``).
+* ``--virtual-devices N``: run on N virtual CPU devices (XLA host-platform
+  device count), which is how scaling sweeps and tests exercise multi-chip
+  code paths on a single host. Must be applied before any JAX device use;
+  the environment's sitecustomize pins jax_platforms to the TPU plugin, so
+  this re-pins to cpu explicitly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def add_platform_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--virtual-devices", type=int, default=None, metavar="N",
+        help="simulate N devices on CPU (scaling studies without a pod)",
+    )
+    parser.add_argument(
+        "--distributed", action="store_true",
+        help="multi-host bootstrap via jax.distributed.initialize()",
+    )
+
+
+def apply_platform_args(args) -> None:
+    import jax
+
+    if args.distributed:
+        jax.distributed.initialize()
+    if args.virtual_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.virtual_devices}"
+        )
+        jax.config.update("jax_platforms", "cpu")

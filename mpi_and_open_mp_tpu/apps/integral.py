@@ -14,6 +14,7 @@ import argparse
 import sys
 import time
 
+from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
 from mpi_and_open_mp_tpu.models.integral import Integral
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils.timing import append_times_txt
@@ -27,7 +28,9 @@ def main(argv=None) -> int:
     p.add_argument("--truncate-32bit", action="store_true",
                    help="reproduce the reference's unsigned-32-bit N overflow")
     p.add_argument("--times-file", default=None)
+    add_platform_args(p)
     args = p.parse_args(argv)
+    apply_platform_args(args)
 
     n = args.n
     if args.truncate_32bit:

@@ -12,6 +12,7 @@ warm-up so XLA compilation isn't billed as simulation.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -19,6 +20,7 @@ import numpy as np
 
 import jax
 
+from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
 from mpi_and_open_mp_tpu.models.life import IMPLS, LAYOUTS, LifeSim
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils.config import load_config
@@ -44,7 +46,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--times-file", default=None,
                    help="append elapsed seconds to this file (times.txt contract)")
     p.add_argument("--print-final-population", action="store_true")
+    p.add_argument("--resume", action="store_true",
+                   help="restart from the latest VTK snapshot in --outdir")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture a jax.profiler trace of the run into DIR")
+    p.add_argument("--debug-check", action="store_true",
+                   help="assert halo-exchange consistency vs the oracle "
+                        "before and after the run")
+    add_platform_args(p)
     return p
+
+
+def find_latest_snapshot(outdir: str) -> tuple[str, int] | None:
+    """Latest ``life_NNNNNN.vtk`` in ``outdir`` and its step index."""
+    import re
+
+    if not outdir or not os.path.isdir(outdir):
+        return None
+    best = None
+    for name in os.listdir(outdir):
+        m = re.fullmatch(r"life_(\d{6,})\.vtk", name)
+        if m:
+            step = int(m.group(1))
+            if best is None or step > best[1]:
+                best = (os.path.join(outdir, name), step)
+    return best
 
 
 def make_mesh(args):
@@ -63,23 +89,45 @@ def make_mesh(args):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    apply_platform_args(args)
     cfg = load_config(args.cfg)
-    sim = LifeSim(
-        cfg,
+    kwargs = dict(
         layout=args.layout,
         impl=args.impl,
         mesh=make_mesh(args),
         fuse_steps=args.fuse_steps,
         outdir=args.outdir,
     )
+    if args.resume:
+        latest = find_latest_snapshot(args.outdir)
+        if latest is None:
+            print(f"--resume: no snapshots in {args.outdir!r}", file=sys.stderr)
+            return 2
+        path, step = latest
+        print(f"resuming from {path} (step {step})", file=sys.stderr)
+        sim = LifeSim.from_snapshot(cfg, path, step, **kwargs)
+    else:
+        sim = LifeSim(cfg, **kwargs)
     # Warm-up: compile every stepper run() will hit, on THIS instance (jit
     # caches are per-instance and keyed on the static step count), so no
     # XLA compilation lands inside the timed bracket.
     sim.warmup()
+    if args.debug_check:
+        sim.debug_check()
 
-    t0 = time.perf_counter()
-    final = sim.run()  # collect() inside forces device completion
-    elapsed = time.perf_counter() - t0
+    if args.profile:
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            t0 = time.perf_counter()
+            final = sim.run()
+            elapsed = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        final = sim.run()  # collect() inside forces device completion
+        elapsed = time.perf_counter() - t0
+    if args.debug_check:
+        sim.debug_check()
 
     print(f"{elapsed:.6f}")
     if args.times_file:

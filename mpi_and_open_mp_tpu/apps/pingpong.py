@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
 from mpi_and_open_mp_tpu.parallel import fabric, mesh as mesh_lib
 
 
@@ -22,7 +23,9 @@ def main(argv=None) -> int:
                    help="probe sizes 10^0..10^k bytes (default 6)")
     p.add_argument("--out", default=None, help="also write CSV here")
     p.add_argument("--fit", action="store_true")
+    add_platform_args(p)
     args = p.parse_args(argv)
+    apply_platform_args(args)
 
     mesh = mesh_lib.make_mesh_1d(args.devices, axis="i")
     sizes = tuple(10**k for k in range(args.max_power + 1))

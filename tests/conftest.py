@@ -44,3 +44,14 @@ def make_board(rng):
         return random_board(rng, ny, nx, density)
 
     return _make
+
+
+def oracle_n(board, n):
+    """Advance ``board`` ``n`` steps through the NumPy oracle (shared by the
+    parity tests; the single source of ground truth)."""
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+
+    b = np.asarray(board)
+    for _ in range(n):
+        b = life_step_numpy(b)
+    return b

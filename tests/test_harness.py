@@ -1,0 +1,108 @@
+"""Harness layer: config suite semantics, plot scripts, hello app, launchers."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpi_and_open_mp_tpu.apps import hello as hello_app
+from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+from mpi_and_open_mp_tpu.utils.config import load_config_py
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIGS = os.path.join(REPO, "configs")
+
+
+from conftest import oracle_n  # noqa: E402
+
+
+def test_config_suite_present_and_parsable():
+    expected = {
+        "test_10x10.cfg": (10, 10, 0),
+        "glider_10x10.cfg": (10, 10, 5),
+        "mix_40x20.cfg": (40, 20, 18),
+        "pulsar_field_500x500.cfg": (500, 500, 64 * 48),
+        "gun_300x100.cfg": (300, 100, 36),
+        "gun_big_500x500.cfg": (500, 500, None),
+    }
+    for name, (nx, ny, ncells) in expected.items():
+        cfg = load_config_py(os.path.join(CONFIGS, name))
+        assert (cfg.nx, cfg.ny) == (nx, ny), name
+        if ncells is not None:
+            assert len(cfg.cells) == ncells, name
+
+
+def test_pulsar_field_period_3():
+    cfg = load_config_py(os.path.join(CONFIGS, "pulsar_field_500x500.cfg"))
+    b0 = cfg.board()
+    assert not np.array_equal(oracle_n(b0, 1), b0)
+    np.testing.assert_array_equal(oracle_n(b0, 3), b0)
+
+
+def test_gosper_gun_emits_gliders():
+    cfg = load_config_py(os.path.join(CONFIGS, "gun_300x100.cfg"))
+    b0 = cfg.board()
+    pop0 = b0.sum()
+    pop120 = oracle_n(b0, 120).sum()
+    # Period-30 gun: 4 gliders after 120 steps -> +20 cells.
+    assert pop120 == pop0 + 4 * 5
+
+
+def test_mix_still_lifes_stable_block():
+    cfg = load_config_py(os.path.join(CONFIGS, "mix_40x20.cfg"))
+    b = oracle_n(cfg.board(), 4)
+    # The block at (2..3, 2..3) must be untouched.
+    assert b[2:4, 2:4].sum() == 4
+
+
+def test_plot_life_script(tmp_path):
+    times = tmp_path / "times.txt"
+    times.write_text("30.0\n16.0\nCommand exited with non-zero status 1\n8.0\n")
+    out = tmp_path / "accel.png"
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import plot_life
+
+    rc = plot_life.main([str(times), str(out)])
+    assert rc == 0 and out.exists() and out.stat().st_size > 1000
+    np.testing.assert_allclose(plot_life.load_times(times), [30.0, 16.0, 8.0])
+
+
+def test_plot_network_script(tmp_path, monkeypatch, capsys):
+    csv = tmp_path / "probe.csv"
+    csv.write_text("size,time\n1,2.5\n1000,3.5\n1000000,1002.5\n")
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import plot_network
+
+    monkeypatch.chdir(tmp_path)
+    rc = plot_network.main([str(csv)])
+    assert rc == 0
+    assert (tmp_path / "network_params.png").exists()
+    assert "alpha=" in capsys.readouterr().out
+
+
+def test_hello_app(capsys):
+    rc = hello_app.main(["--devices", "8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ring ok" in out
+    assert "device 3 received hello from device 2" in out
+
+
+def test_run_life_launcher_virtual(tmp_path):
+    """End-to-end launcher sweep on the virtual CPU mesh (2 points)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    times_path = tmp_path / "times.txt"
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "launchers", "run_life.sh"),
+         "--cfg=configs/glider_10x10.cfg", "--max-dev=2", "--virtual",
+         "--layout=row", f"--times-file={times_path}"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in times_path.read_text().strip().split("\n") if l]
+    assert len(lines) == 2
+    for l in lines:
+        float(l)

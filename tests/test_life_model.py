@@ -21,11 +21,7 @@ from mpi_and_open_mp_tpu.utils.vtk import read_vtk
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
 
-def oracle_n(board, n):
-    b = np.asarray(board)
-    for _ in range(n):
-        b = life_step_numpy(b)
-    return b
+from conftest import oracle_n  # noqa: E402
 
 
 @pytest.mark.parametrize("layout", ["serial", "row", "col", "cart"])

@@ -15,11 +15,7 @@ from mpi_and_open_mp_tpu.ops.life_ops import (
 from mpi_and_open_mp_tpu.utils.config import config_from_board
 
 
-def oracle_n(board, n):
-    b = np.asarray(board)
-    for _ in range(n):
-        b = life_step_numpy(b)
-    return b
+from conftest import oracle_n  # noqa: E402
 
 
 @pytest.mark.parametrize("shape,steps", [((16, 16), 8), ((10, 10), 40), ((33, 65), 5)])
