@@ -9,6 +9,7 @@ fixed-seed random soup — cups is content-independent for a dense stencil.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
+import argparse
 import json
 import sys
 import time
@@ -21,7 +22,18 @@ NY = NX = 500
 STEPS = 10_000
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--board", type=int, default=None, metavar="N",
+                    help="override board edge (e.g. 8192 for the big-grid "
+                    "strong-scaling config); default 500 (p46gun_big)")
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args(argv)
+    global NY, NX, STEPS
+    if args.board:
+        NY = NX = args.board
+    if args.steps:
+        STEPS = args.steps
     import jax
 
     from mpi_and_open_mp_tpu.models.life import LifeSim

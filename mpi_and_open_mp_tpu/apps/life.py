@@ -12,6 +12,7 @@ warm-up so XLA compilation isn't billed as simulation.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -118,11 +119,10 @@ def main(argv=None) -> int:
     if args.profile:
         import jax
 
-        with jax.profiler.trace(args.profile):
-            t0 = time.perf_counter()
-            final = sim.run()
-            elapsed = time.perf_counter() - t0
+        ctx = jax.profiler.trace(args.profile)
     else:
+        ctx = contextlib.nullcontext()
+    with ctx:
         t0 = time.perf_counter()
         final = sim.run()  # collect() inside forces device completion
         elapsed = time.perf_counter() - t0
