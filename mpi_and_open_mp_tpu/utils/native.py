@@ -47,6 +47,13 @@ def _load():
         ctypes.c_longlong,
         ctypes.c_longlong,
     ]
+    lib.lifeio_life_steps.restype = None
+    lib.lifeio_life_steps.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+    ]
     _LIB = lib
     return _LIB
 
@@ -86,6 +93,22 @@ def load_config(path):
     finally:
         lib.lifeio_free(cells_ptr)
     return LifeConfig(steps=steps, save_steps=save_steps, nx=nx, ny=ny, cells=cells)
+
+
+def life_steps(board: np.ndarray, steps: int) -> np.ndarray:
+    """Advance ``steps`` generations through the native C++ oracle.
+
+    An independent compiled ground truth (same role as the reference's
+    ``life2d`` binary) — used by tests to cross-check the NumPy oracle and
+    by hosts that want a fast serial path without JAX.
+    """
+    lib = _require()
+    out = np.ascontiguousarray(board, dtype=np.uint8).copy()
+    ny, nx = out.shape
+    lib.lifeio_life_steps(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), nx, ny, int(steps)
+    )
+    return out
 
 
 def write_vtk(path, board: np.ndarray) -> None:

@@ -16,6 +16,8 @@
 #include <string>
 #include <vector>
 
+using std::uint8_t;
+
 extern "C" {
 
 // Parse a .cfg file: header[5] = {steps, save_steps, nx, ny, ncells};
@@ -97,6 +99,32 @@ int lifeio_write_vtk(const char *path, const int *board, long long nx,
     size_t wrote = std::fwrite(out.data(), 1, out.size(), fd);
     std::fclose(fd);
     return wrote == out.size() ? 0 : -2;
+}
+
+// Serial Game-of-Life oracle: advance a (ny, nx) uint8 board `steps`
+// generations on a periodic torus. Same role as the reference's compiled
+// life2d oracle (/root/reference/3-life/life2d.c:104-130): an independent,
+// native ground truth the JAX/Pallas kernels are checked against — written
+// here as a scanline pass with explicit wrap rows/columns rather than the
+// reference's per-cell modular ind() arithmetic.
+void lifeio_life_steps(uint8_t *board, long long nx, long long ny,
+                       long long steps) {
+    std::vector<uint8_t> next(static_cast<size_t>(nx * ny));
+    for (long long s = 0; s < steps; ++s) {
+        for (long long j = 0; j < ny; ++j) {
+            const uint8_t *up = board + ((j - 1 + ny) % ny) * nx;
+            const uint8_t *mid = board + j * nx;
+            const uint8_t *dn = board + ((j + 1) % ny) * nx;
+            uint8_t *out = next.data() + j * nx;
+            for (long long i = 0; i < nx; ++i) {
+                long long il = (i - 1 + nx) % nx, ir = (i + 1) % nx;
+                int n = up[il] + up[i] + up[ir] + mid[il] + mid[ir] +
+                        dn[il] + dn[i] + dn[ir];
+                out[i] = (n == 3 || (n == 2 && mid[i])) ? 1 : 0;
+            }
+        }
+        std::memcpy(board, next.data(), static_cast<size_t>(nx * ny));
+    }
 }
 
 }  // extern "C"

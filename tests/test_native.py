@@ -57,6 +57,23 @@ def test_native_vtk_matches_python(tmp_path, make_board):
     np.testing.assert_array_equal(read_vtk(p_native), board)
 
 
+def test_native_oracle_matches_numpy(make_board):
+    """Two independent oracles (C++ scanline vs NumPy roll) must agree —
+    the strongest form of the reference's serial-parity discipline."""
+    from conftest import oracle_n
+
+    for shape in [(10, 10), (17, 23), (64, 48)]:
+        b = make_board(*shape)
+        np.testing.assert_array_equal(native.life_steps(b, 12), oracle_n(b, 12))
+    # Glider translation survives the torus in the native oracle too.
+    g = np.zeros((10, 10), np.uint8)
+    for i, j in [(0, 2), (1, 0), (1, 2), (2, 1), (2, 2)]:
+        g[j, i] = 1
+    np.testing.assert_array_equal(
+        native.life_steps(g, 40), g  # period 40 on a 10x10 torus
+    )
+
+
 def test_native_roundtrip_config(tmp_path, make_board):
     board = make_board(9, 9)
     cfg = config_from_board(board, 7, 3)
