@@ -48,7 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append elapsed seconds to this file (times.txt contract)")
     p.add_argument("--print-final-population", action="store_true")
     p.add_argument("--resume", action="store_true",
-                   help="restart from the latest VTK snapshot in --outdir")
+                   help="restart from the latest Orbax checkpoint in "
+                        "--checkpoint-dir, else the latest VTK in --outdir")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="write an Orbax checkpoint at every save point "
+                        "(sharded; no gather-to-root on multi-host)")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the run into DIR")
     p.add_argument("--debug-check", action="store_true",
@@ -58,20 +62,31 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def find_latest_snapshot(outdir: str) -> tuple[str, int] | None:
-    """Latest ``life_NNNNNN.vtk`` in ``outdir`` and its step index."""
+def _find_latest(directory: str, pattern: str) -> tuple[str, int] | None:
+    """Highest-step entry in ``directory`` matching ``pattern`` (one numeric
+    group = the step index)."""
     import re
 
-    if not outdir or not os.path.isdir(outdir):
+    if not directory or not os.path.isdir(directory):
         return None
     best = None
-    for name in os.listdir(outdir):
-        m = re.fullmatch(r"life_(\d{6,})\.vtk", name)
+    for name in os.listdir(directory):
+        m = re.fullmatch(pattern, name)
         if m:
             step = int(m.group(1))
             if best is None or step > best[1]:
-                best = (os.path.join(outdir, name), step)
+                best = (os.path.join(directory, name), step)
     return best
+
+
+def find_latest_snapshot(outdir: str) -> tuple[str, int] | None:
+    """Latest ``life_NNNNNN.vtk`` in ``outdir`` and its step index."""
+    return _find_latest(outdir, r"life_(\d{6,})\.vtk")
+
+
+def find_latest_checkpoint(ckpt_dir: str) -> tuple[str, int] | None:
+    """Latest ``step_NNNNNN`` Orbax checkpoint in ``ckpt_dir``."""
+    return _find_latest(ckpt_dir, r"step_(\d{6,})")
 
 
 def make_mesh(args):
@@ -98,15 +113,26 @@ def main(argv=None) -> int:
         mesh=make_mesh(args),
         fuse_steps=args.fuse_steps,
         outdir=args.outdir,
+        checkpoint_dir=args.checkpoint_dir,
     )
     if args.resume:
-        latest = find_latest_snapshot(args.outdir)
-        if latest is None:
-            print(f"--resume: no snapshots in {args.outdir!r}", file=sys.stderr)
+        # Resume from whichever persisted state is newest (a stale
+        # checkpoint dir must not roll back past newer VTK snapshots).
+        ckpt = find_latest_checkpoint(args.checkpoint_dir)
+        snap = find_latest_snapshot(args.outdir)
+        if ckpt is not None and (snap is None or ckpt[1] >= snap[1]):
+            path, step = ckpt
+            print(f"resuming from checkpoint {path} (step {step})",
+                  file=sys.stderr)
+            sim = LifeSim.from_checkpoint(path, cfg, **kwargs)
+        elif snap is not None:
+            path, step = snap
+            print(f"resuming from {path} (step {step})", file=sys.stderr)
+            sim = LifeSim.from_snapshot(cfg, path, step, **kwargs)
+        else:
+            print(f"--resume: no checkpoints in {args.checkpoint_dir!r} and "
+                  f"no snapshots in {args.outdir!r}", file=sys.stderr)
             return 2
-        path, step = latest
-        print(f"resuming from {path} (step {step})", file=sys.stderr)
-        sim = LifeSim.from_snapshot(cfg, path, step, **kwargs)
     else:
         sim = LifeSim(cfg, **kwargs)
     # Warm-up: compile every stepper run() will hit, on THIS instance (jit

@@ -106,6 +106,7 @@ class LifeSim:
         fuse_steps: int = 1,
         dtype=jnp.uint8,
         outdir: str | os.PathLike | None = None,
+        checkpoint_dir: str | os.PathLike | None = None,
         initial_board: np.ndarray | None = None,
         initial_step: int = 0,
     ):
@@ -119,6 +120,9 @@ class LifeSim:
         self.fuse_steps = max(1, int(fuse_steps))
         self.dtype = dtype
         self.outdir = os.fspath(outdir) if outdir is not None else None
+        self.checkpoint_dir = (
+            os.fspath(checkpoint_dir) if checkpoint_dir is not None else None
+        )
         self.step_count = int(initial_step)
 
         divisible = _divisible(cfg.shape, layout, self.mesh)
@@ -283,6 +287,26 @@ class LifeSim:
         )
         self.step_count = self._initial_step
 
+    def save_checkpoint(self, path: str | os.PathLike) -> None:
+        """Orbax checkpoint of the live sharded state (see utils.checkpoint:
+        no gather-to-root on multi-host, unlike the VTK snapshot path)."""
+        from mpi_and_open_mp_tpu.utils import checkpoint
+
+        checkpoint.save(path, self.board, self.step_count)
+
+    @classmethod
+    def from_checkpoint(
+        cls, path: str | os.PathLike, cfg: LifeConfig, **kwargs
+    ) -> "LifeSim":
+        """Resume from an Orbax checkpoint, re-sharding onto this mesh."""
+        from mpi_and_open_mp_tpu.utils import checkpoint
+
+        board, step = checkpoint.restore(path)
+        # Stored state is the padded board; crop to the logical shape (the
+        # constructor re-pads for its own mesh).
+        board = board[: cfg.ny, : cfg.nx]
+        return cls(cfg, initial_board=board, initial_step=step, **kwargs)
+
     @classmethod
     def from_snapshot(
         cls, cfg: LifeConfig, snapshot_path: str, step: int, **kwargs
@@ -360,6 +384,16 @@ class LifeSim:
         vtk_lib.write_vtk(path, self.collect())
         return path
 
+    def save_state(self) -> None:
+        """Persist the current step through every configured channel: VTK
+        snapshot (``outdir``) and/or Orbax checkpoint (``checkpoint_dir``)."""
+        if self.outdir is not None:
+            self.save_snapshot()
+        if self.checkpoint_dir is not None:
+            self.save_checkpoint(
+                os.path.join(self.checkpoint_dir, f"step_{self.step_count:06d}")
+            )
+
     def run(self, save: bool | None = None) -> np.ndarray:
         """Run ``cfg.steps`` steps with the reference's save cadence.
 
@@ -369,7 +403,7 @@ class LifeSim:
         """
         cfg = self.cfg
         if save is None:
-            save = self.outdir is not None
+            save = self.outdir is not None or self.checkpoint_dir is not None
         # save_steps <= 0 means "never save" (the reference's 999999 idiom,
         # p46gun_big.cfg, taken to its limit); so does save=False.
         if not save or cfg.save_steps <= 0:
@@ -379,7 +413,7 @@ class LifeSim:
         i = self.step_count
         while i < cfg.steps:
             if i % cfg.save_steps == 0:
-                self.save_snapshot()
+                self.save_state()
             # Advance to the next save point (or the end) in one jit call.
             next_stop = min(cfg.steps, (i // cfg.save_steps + 1) * cfg.save_steps)
             self.step(next_stop - i)
