@@ -130,8 +130,12 @@ def main(argv=None) -> int:
             print(f"resuming from {path} (step {step})", file=sys.stderr)
             sim = LifeSim.from_snapshot(cfg, path, step, **kwargs)
         else:
-            print(f"--resume: no checkpoints in {args.checkpoint_dir!r} and "
-                  f"no snapshots in {args.outdir!r}", file=sys.stderr)
+            sources = [f"no snapshots in {args.outdir!r}"]
+            if args.checkpoint_dir is not None:
+                sources.insert(
+                    0, f"no checkpoints in {args.checkpoint_dir!r}"
+                )
+            print(f"--resume: {' and '.join(sources)}", file=sys.stderr)
             return 2
     else:
         sim = LifeSim(cfg, **kwargs)

@@ -88,14 +88,25 @@ def tiled_supported(shape: tuple[int, int]) -> bool:
 
 
 def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
-    """Advance ``n`` steps on one device, picking the resident-or-tiled path.
+    """Advance ``n`` steps on one device, picking the fastest native path.
 
-    ``n`` is a runtime scalar (SMEM) — changing it does not recompile.
-    Boards that fit run the whole loop VMEM-resident in a single kernel
-    launch; larger boards run the HBM row-tiled kernel once per step.
+    The board is bit-packed (32 cells/uint32 word — see ``ops.bitlife``):
+    packed boards up to ~2900² stay VMEM-resident with the whole step loop
+    in one kernel launch (interpret-mode on CPU, so tests exercise the
+    production dispatch); bigger boards on TPU run the packed HBM
+    row-tiled kernel at 1/32nd the bandwidth of an int32 stencil. ``n`` is
+    a runtime scalar (SMEM) — changing it does not recompile.
     """
+    from mpi_and_open_mp_tpu.ops import bitlife
+
     dtype = board.dtype
     steps = jnp.asarray([n], dtype=jnp.int32)
+    if bitlife.fits_vmem_packed(board.shape):
+        return bitlife.life_run_vmem_bits(board, n, interpret=_interpret())
+    if not _interpret() and bitlife.tiled_bits_supported(board.shape):
+        # Big boards in interpret mode skip to the compiled XLA fallback
+        # below — interpret-mode Pallas at that size is impractical.
+        return bitlife.life_run_tiled_bits(board, n)
     if fits_vmem(board.shape):
         out = _run_vmem_jit(board.astype(jnp.int32), steps, interpret=_interpret())
     elif _interpret() or not tiled_supported(board.shape):

@@ -17,18 +17,29 @@ import numpy as np
 
 import jax
 
+_CKPTR = None
+
+
+def _checkpointer():
+    """Module-cached PyTreeCheckpointer: constructing one spins up thread
+    pools and a tensorstore context, too costly to pay per save inside the
+    timed simulation loop (the times.txt bracket includes saves)."""
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _CKPTR = ocp.PyTreeCheckpointer()
+    return _CKPTR
+
 
 def save(path: str | os.PathLike, board: jax.Array, step: int) -> None:
     """Write ``{board, step}`` as an Orbax checkpoint at ``path``."""
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(os.fspath(path))
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(
-            path,
-            {"board": board, "step": np.int64(step)},
-            force=True,
-        )
+    _checkpointer().save(
+        path,
+        {"board": board, "step": np.int64(step)},
+        force=True,
+    )
 
 
 def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
@@ -37,9 +48,6 @@ def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
     The caller re-shards onto its own mesh (``LifeSim(initial_board=...)``);
     restoring host-side keeps restore mesh-shape-agnostic.
     """
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(os.fspath(path))
-    with ocp.PyTreeCheckpointer() as ckptr:
-        tree = ckptr.restore(path)
+    tree = _checkpointer().restore(path)
     return np.asarray(tree["board"], dtype=np.uint8), int(tree["step"])

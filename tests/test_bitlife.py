@@ -1,0 +1,112 @@
+"""Bit-packed kernel parity vs the NumPy oracle (SURVEY §4 mechanism 1).
+
+The packed layout has three hazard zones the shapes below target: the
+word-crossing single-bit shifts (ny straddling multiples of 32), the
+offset-ghost torus wrap rows, and the tile seams of the HBM row-tiled
+variant (forced with tiny ``max_tile_bytes``). All runs are interpret-mode
+Pallas on CPU — the same kernel code Mosaic compiles on TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conftest import oracle_n as _oracle
+
+from mpi_and_open_mp_tpu.ops import bitlife
+
+
+def _soup(ny, nx, seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    return (rng.random((ny, nx)) < density).astype(np.uint8)
+
+
+SHAPES = [(3, 5), (10, 10), (30, 8), (31, 8), (32, 8), (33, 37), (100, 33)]
+
+
+@pytest.mark.parametrize("ny,nx", SHAPES)
+def test_pack_roundtrip(ny, nx):
+    b = _soup(ny, nx)
+    packed = bitlife.pack_board(jnp.asarray(b))
+    assert packed.shape == (bitlife.n_words(ny), nx)
+    assert np.array_equal(np.asarray(bitlife.unpack_board(packed, ny)), b)
+
+
+@pytest.mark.parametrize("ny,nx", SHAPES)
+def test_vmem_bits_parity(ny, nx):
+    b = _soup(ny, nx)
+    got = np.asarray(
+        bitlife.life_run_vmem_bits(jnp.asarray(b), 7, interpret=True)
+    )
+    assert np.array_equal(got, _oracle(b, 7)), (ny, nx)
+
+
+def test_vmem_bits_glider_torus():
+    """Period-4 glider translation incl. the torus wrap (SURVEY §4 fixture)."""
+    b = np.zeros((10, 10), np.uint8)
+    for j, i in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+        b[j, i] = 1
+    got = np.asarray(
+        bitlife.life_run_vmem_bits(jnp.asarray(b), 100, interpret=True)
+    )
+    assert np.array_equal(got, _oracle(b, 100))
+    assert got.sum() == 5
+
+
+@pytest.mark.parametrize(
+    "ny,nx,mtb",
+    [(300, 33, 1400), (257, 16, 640), (600, 9, 360), (700, 20, 800)],
+)
+def test_tiled_bits_parity_multitile(ny, nx, mtb):
+    """Forced 8-word-row tiles over >8-word boards: exercises tile seams
+    and the padded junk words of ``_tiled_bits_kernel`` (nwp > nw for
+    several of these shapes)."""
+    b = _soup(ny, nx, seed=1)
+    got = np.asarray(
+        bitlife.life_run_tiled_bits(
+            jnp.asarray(b), 5, interpret=True, max_tile_bytes=mtb
+        )
+    )
+    assert np.array_equal(got, _oracle(b, 5)), (ny, nx)
+
+
+def test_tiled_bits_parity_single_tile():
+    b = _soup(64, 24, seed=2)
+    got = np.asarray(
+        bitlife.life_run_tiled_bits(jnp.asarray(b), 6, interpret=True)
+    )
+    assert np.array_equal(got, _oracle(b, 6))
+
+
+def test_steps_runtime_scalar_no_retrace():
+    """Changing the step count must reuse the compiled kernel (SMEM scalar)."""
+    b = jnp.asarray(_soup(20, 20))
+    f = bitlife._run_vmem_bits_jit
+    bitlife.life_run_vmem_bits(b, 1, interpret=True)
+    misses = f._cache_miss_count if hasattr(f, "_cache_miss_count") else None
+    before = f._cache_size()
+    bitlife.life_run_vmem_bits(b, 3, interpret=True)
+    assert f._cache_size() == before
+    del misses
+
+
+def test_tiled_bits_gate_ultrawide():
+    """Ultra-wide boards have no Mosaic-legal in-budget tile split; the
+    dispatch gate must reject them (life_run_vmem then falls back to the
+    compiled XLA roll loop instead of a VMEM-overflowing kernel)."""
+    assert not bitlife.tiled_bits_supported((8192, 131072))
+    assert bitlife.tiled_bits_supported((8192, 8192))
+    with pytest.raises(ValueError, match="tiled_bits_supported"):
+        bitlife.life_run_tiled_bits(
+            jnp.zeros((40, 12), jnp.uint8), 1, interpret=True,
+            max_tile_bytes=64,
+        )
+
+
+def test_empty_board_stays_empty():
+    b = np.zeros((40, 12), np.uint8)
+    got = np.asarray(
+        bitlife.life_run_vmem_bits(jnp.asarray(b), 10, interpret=True)
+    )
+    assert got.sum() == 0
