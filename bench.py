@@ -67,9 +67,31 @@ def main(argv=None) -> int:
         sim.reset()
         t0 = time.perf_counter()
         sim.step(STEPS)
-        sim.collect()  # device_get: block_until_ready is a no-op on axon
+        sim.sync()
         best = min(best, time.perf_counter() - t0)
 
+    # Steady-state rate: the single-run number above carries one fixed
+    # host->device dispatch round trip (~70 ms on a tunneled axon chip —
+    # measured via a scalar fetch; a co-located host pays ~none), which
+    # swamps an 8 ms compute. On the pallas path the step count is a
+    # runtime SMEM scalar, so a single 41x-longer dispatch reuses the same
+    # executable; the difference isolates the marginal per-step rate. The
+    # other impls jit with a static step count (the longer dispatch would
+    # recompile — and on CPU also grind through 41x the steps), so they
+    # just report the end-to-end number.
+    # Only worth it while the single run is RTT-dominated; a multi-second
+    # big-board run already measures compute, and 41x it would burn
+    # minutes of chip time to reproduce the same number.
+    steady = best
+    if sim.impl == "pallas" and best < 1.0:
+        mult = 41
+        sim.reset()
+        t0 = time.perf_counter()
+        sim.step(STEPS * mult)
+        sim.sync()
+        chained = time.perf_counter() - t0
+        if chained > best:
+            steady = (chained - best) / (mult - 1)
     cups = NY * NX * STEPS / best
     print(json.dumps({
         "metric": "life_cups_p46gun_big",
@@ -77,6 +99,8 @@ def main(argv=None) -> int:
         "unit": "cell_updates_per_sec",
         "vs_baseline": round(cups / BASELINE_CUPS, 2),
         "elapsed_sec": round(best, 4),
+        "steady_state_cups": round(NY * NX * STEPS / steady, 1),
+        "steady_state_vs_baseline": round(NY * NX * STEPS / steady / BASELINE_CUPS, 2),
         "backend": jax.default_backend(),
         "impl": sim.impl,
     }))

@@ -279,6 +279,16 @@ class LifeSim:
         self.board = self._advance(self.board, int(n))
         self.step_count += n
 
+    def sync(self) -> None:
+        """Wait for all dispatched device work on the board to complete.
+
+        The timing analog of the reference's implicit synchronisation at
+        its ``MPI_Wtime`` bracket (``3-life/life_mpi.c:64-67``): JAX
+        dispatch is async, so timed sections must end here (or at a host
+        fetch). Unlike :meth:`collect`, no board bytes cross the host link.
+        """
+        jax.block_until_ready(self.board)
+
     def reset(self) -> None:
         """Restore the initial board without rebuilding compiled steppers."""
         board = jnp.asarray(self._initial, dtype=self.dtype)

@@ -23,8 +23,10 @@ refreshes the two ghost bits from live state, then
   ``life2d.c:117-123``).
 
 The whole step loop runs inside one ``pallas_call`` with the packed board
-VMEM-resident; a 500x500 board packs to 16x500 uint32 = 32 KB, and even
-4096x4096 packs to ~2 MB — far under the ~16 MB/core VMEM budget.
+VMEM-resident; a 500x500 board packs to 16x500 uint32 = 32 KB. The gate
+is the packed bytes times the ~11 live step temporaries against the
+~16 MB/core scoped-VMEM budget (see ``_PACKED_VMEM_LIMIT``): ~3200² is
+the measured ceiling; beyond it the HBM row-tiled kernel takes over.
 """
 
 from __future__ import annotations
@@ -39,9 +41,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Packed board bytes kept VMEM-resident; leave room for ~10 live
-# temporaries of the same shape inside the ~16 MB/core budget.
-_PACKED_VMEM_LIMIT = 1 << 21
+# Packed board bytes kept VMEM-resident. The step body holds ~10 live
+# same-shape temporaries, so the working set is ~11x the board against the
+# ~16 MB/core scoped-VMEM budget; measured on v5e: 1.23 MB packed (3200²)
+# compiles, 1.47 MB (3500²) is rejected by Mosaic.
+_PACKED_VMEM_LIMIT = 5 << 18
 
 
 def n_words(ny: int) -> int:
@@ -245,22 +249,28 @@ def _tiled_bits_kernel(hbm_ref, out_ref, scratch, sem):
 def _tile_words(nw: int, nx: int, max_tile_bytes: int = 1 << 20) -> int:
     """Packed word rows per tile, keeping the scratch window in budget.
 
-    Multi-tile grids need the output block's sublane dim divisible by 8
-    (Mosaic tiling); a single tile equal to the whole array is exempt.
-    Returns 0 when no in-budget multi-tile split exists (ultra-wide nx) —
-    callers must gate on :func:`tiled_bits_supported`.
+    Always a multiple of 8: every explicit-DMA memref slice (offset AND
+    extent) must be sublane-aligned on real Mosaic — including the
+    single-tile case, whose window is ``tr + 16`` rows of the padded
+    carry. The budget covers the full ``(tr + 16, nx)`` scratch window.
+    Returns <8 when no in-budget split exists (ultra-wide nx) — callers
+    must gate on :func:`tiled_bits_supported`.
     """
-    cap = max_tile_bytes // (4 * nx) - 2
-    if cap >= nw:
-        return nw
-    return (cap // 8) * 8
+    cap = (max_tile_bytes // (4 * nx) - 16) // 8 * 8
+    return min(cap, -(-nw // 8) * 8)
 
 
 def tiled_bits_supported(shape: tuple[int, int]) -> bool:
-    """Whether the packed row-tiled kernel can split ``shape`` into
-    Mosaic-legal, VMEM-budgeted tiles (at least 8 word rows per tile)."""
+    """Whether the packed row-tiled kernel can run ``shape`` COMPILED.
+
+    Two hardware constraints (interpret mode has neither, so tests may
+    drive unaligned shapes directly): the lane dim must be 128-aligned —
+    an explicit-DMA VMEM scratch with a padded lane allocation lowers to
+    a lane-unaligned ``memref_slice``, which Mosaic rejects — and the
+    tile split must fit the VMEM budget with at least 8 word rows.
+    """
     ny, nx = shape
-    return _tile_words(n_words(ny), nx) >= 8
+    return nx % 128 == 0 and _tile_words(n_words(ny), nx) >= 8
 
 
 def _refresh_ghosts_ext(ext: jnp.ndarray, ny: int) -> jnp.ndarray:
@@ -292,7 +302,7 @@ def _run_tiled_bits_jit(
 ):
     nw, nx = packed.shape
     tr = _tile_words(nw, nx, max_tile_bytes)
-    if tr < 1:
+    if tr < 8:
         raise ValueError(
             f"no in-budget tile split for packed shape {(nw, nx)}; gate "
             "callers on tiled_bits_supported()"

@@ -4,20 +4,23 @@ The reference's "native layer" is its compiled C kernels
 (``/root/reference/3-life/life_mpi.c:150-176`` and friends); here the native
 compute layer is Mosaic-compiled Pallas:
 
-* ``life_run_vmem`` — the flagship single-shard kernel. The whole board
-  lives in VMEM (a 500x500 int32 board is 1 MB — far under the ~16 MB/core
-  budget) and the ENTIRE step loop runs inside one kernel launch via
-  ``lax.fori_loop``, so 10,000 steps cost one dispatch and zero HBM round
-  trips. Torus wrap is ``pltpu.roll`` (circular shift) on both axes —
-  exactly the reference's ``ind()`` modular indexing
-  (``3-life/life2d.c:9``), vectorised on the VPU.
+* ``life_run_vmem`` — the flagship single-shard dispatcher. Boards up to
+  ~3200² bit-pack into VMEM (``ops.bitlife``) with the ENTIRE step loop
+  inside one kernel launch, so 10,000 steps cost one dispatch and zero
+  HBM round trips; bigger 128-lane-aligned boards stream through the
+  packed HBM row-tiled kernel; anything else takes the compiled XLA roll
+  loop. Torus wrap everywhere is circular shifting — exactly the
+  reference's ``ind()`` modular indexing (``3-life/life2d.c:9``),
+  vectorised on the VPU.
 * ``life_step_padded_pallas`` — one stencil step over a halo-padded block,
   used as the per-shard kernel inside the ``shard_map`` halo path.
-* ``life_step_tiled`` — the big-board kernel (8192²+): the board stays in
-  HBM; a 1-D grid of programs DMAs overlapping row-tiles (tile + one ghost
-  row each side, torus rows resolved modulo ny) into VMEM scratch, applies
-  the stencil with lane-rolled x wrap, and writes the tile back — one HBM
-  read pass + one write pass per step, the stencil's bandwidth floor.
+* ``life_step_tiled`` — int32 HBM row-tiled stencil: a 1-D grid of
+  programs DMAs overlapping row-tiles (tile + one ghost row each side,
+  torus rows resolved modulo ny) into VMEM scratch. Superseded for
+  big boards by the packed ``bitlife`` tiled kernel (1/32nd the
+  bandwidth); its unaligned ghost-row DMA slices also only lower in
+  interpret mode, so the production dispatch no longer reaches it on
+  hardware.
 
 All are bit-exact against the NumPy oracle (integer 0/1 state). On
 non-TPU backends the kernels run in Pallas interpret mode so CPU tests
@@ -44,38 +47,6 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _step_roll_tpu(b: jnp.ndarray) -> jnp.ndarray:
-    """One torus step via circular shifts (separable: 4 rolls).
-
-    ``pltpu.roll`` only takes non-negative shifts, so a -1 roll is a
-    ``dim - 1`` roll (shapes are static).
-    """
-    ny, nx = b.shape
-    rows = b + pltpu.roll(b, 1, 0) + pltpu.roll(b, ny - 1, 0)
-    n = rows + pltpu.roll(rows, 1, 1) + pltpu.roll(rows, nx - 1, 1) - b
-    return life_ops.life_rule(b, n)
-
-
-def _vmem_loop_kernel(steps_ref, board_ref, out_ref):
-    out_ref[:] = lax.fori_loop(
-        0, steps_ref[0], lambda _, b: _step_roll_tpu(b), board_ref[:]
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _run_vmem_jit(board_i32: jnp.ndarray, steps: jnp.ndarray, *, interpret: bool):
-    return pl.pallas_call(
-        _vmem_loop_kernel,
-        out_shape=jax.ShapeDtypeStruct(board_i32.shape, board_i32.dtype),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(steps, board_i32)
-
-
 def fits_vmem(shape: tuple[int, int]) -> bool:
     ny, nx = shape
     return ny * nx * 4 <= _VMEM_BYTES_LIMIT
@@ -91,7 +62,7 @@ def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     """Advance ``n`` steps on one device, picking the fastest native path.
 
     The board is bit-packed (32 cells/uint32 word — see ``ops.bitlife``):
-    packed boards up to ~2900² stay VMEM-resident with the whole step loop
+    packed boards up to ~3200² stay VMEM-resident with the whole step loop
     in one kernel launch (interpret-mode on CPU, so tests exercise the
     production dispatch); bigger boards on TPU run the packed HBM
     row-tiled kernel at 1/32nd the bandwidth of an int32 stencil. ``n`` is
@@ -99,24 +70,18 @@ def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     """
     from mpi_and_open_mp_tpu.ops import bitlife
 
-    dtype = board.dtype
-    steps = jnp.asarray([n], dtype=jnp.int32)
     if bitlife.fits_vmem_packed(board.shape):
         return bitlife.life_run_vmem_bits(board, n, interpret=_interpret())
     if not _interpret() and bitlife.tiled_bits_supported(board.shape):
         # Big boards in interpret mode skip to the compiled XLA fallback
         # below — interpret-mode Pallas at that size is impractical.
         return bitlife.life_run_tiled_bits(board, n)
-    if fits_vmem(board.shape):
-        out = _run_vmem_jit(board.astype(jnp.int32), steps, interpret=_interpret())
-    elif _interpret() or not tiled_supported(board.shape):
-        # Interpret-mode Pallas is orders of magnitude too slow for a big
-        # board, and ultra-wide boards can't row-tile; both get the
-        # natively-compiled XLA roll loop instead.
-        out = _run_roll_fallback(board, jnp.int32(n))
-    else:
-        out = _run_tiled_jit(board.astype(jnp.int32), steps, interpret=False)
-    return out.astype(dtype)
+    # Remaining cases — lane-unaligned or ultra-wide big boards, and any
+    # big board in interpret mode — get the natively-compiled XLA roll
+    # loop: explicit-DMA row tiling needs a 128-aligned lane dim on real
+    # Mosaic (see bitlife.tiled_bits_supported), and interpret-mode
+    # Pallas is orders of magnitude too slow.
+    return _run_roll_fallback(board, jnp.int32(n)).astype(board.dtype)
 
 
 @jax.jit
@@ -228,23 +193,21 @@ def life_step_padded_pallas(padded: jnp.ndarray) -> jnp.ndarray:
     """
     h, w = padded.shape[0] - 2, padded.shape[1] - 2
     dtype = padded.dtype
-    if not fits_vmem(padded.shape) and (
-        _interpret() or not tiled_supported(padded.shape)
-    ):
-        # Same escape hatch as life_run_vmem: compiled jnp stencil instead
-        # of interpret-mode Pallas or an untileable ultra-wide block.
+    if not fits_vmem(padded.shape):
+        # Over-VMEM blocks take the compiled jnp stencil: a halo-padded
+        # block has odd dims by construction, and the explicit-DMA row
+        # tiling that would stream it needs sublane/lane-aligned slices on
+        # real Mosaic (``_step_tiled_padded`` stays for interpret-mode
+        # coverage of the kernel body).
         return life_ops.life_step_padded(padded)
     p32 = padded.astype(jnp.int32)
-    if fits_vmem(padded.shape):
-        out = pl.pallas_call(
-            _padded_step_kernel,
-            out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            interpret=_interpret(),
-        )(p32)
-    else:
-        out = _step_tiled_padded(p32, interpret=_interpret())
+    out = pl.pallas_call(
+        _padded_step_kernel,
+        out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(p32)
     return out.astype(dtype)
 
 

@@ -56,7 +56,7 @@ def test_vmem_bits_glider_torus():
 
 @pytest.mark.parametrize(
     "ny,nx,mtb",
-    [(300, 33, 1400), (257, 16, 640), (600, 9, 360), (700, 20, 800)],
+    [(300, 33, 3200), (257, 16, 1600), (600, 9, 900), (700, 20, 2000)],
 )
 def test_tiled_bits_parity_multitile(ny, nx, mtb):
     """Forced 8-word-row tiles over >8-word boards: exercises tile seams
@@ -84,11 +84,9 @@ def test_steps_runtime_scalar_no_retrace():
     b = jnp.asarray(_soup(20, 20))
     f = bitlife._run_vmem_bits_jit
     bitlife.life_run_vmem_bits(b, 1, interpret=True)
-    misses = f._cache_miss_count if hasattr(f, "_cache_miss_count") else None
     before = f._cache_size()
     bitlife.life_run_vmem_bits(b, 3, interpret=True)
     assert f._cache_size() == before
-    del misses
 
 
 def test_tiled_bits_gate_ultrawide():
@@ -97,6 +95,11 @@ def test_tiled_bits_gate_ultrawide():
     compiled XLA roll loop instead of a VMEM-overflowing kernel)."""
     assert not bitlife.tiled_bits_supported((8192, 131072))
     assert bitlife.tiled_bits_supported((8192, 8192))
+    # Lane-unaligned nx compiles in interpret mode only; the hardware
+    # dispatch gate must reject it (Mosaic memref_slice lane alignment).
+    assert not bitlife.tiled_bits_supported((8192, 500))
+    # Single-tile boards still need 8-aligned DMA extents on hardware.
+    assert bitlife._tile_words(bitlife.n_words(2048), 2048) % 8 == 0
     with pytest.raises(ValueError, match="tiled_bits_supported"):
         bitlife.life_run_tiled_bits(
             jnp.zeros((40, 12), jnp.uint8), 1, interpret=True,
