@@ -346,7 +346,7 @@ def life_run_tiled_bits(
 ) -> jnp.ndarray:
     """Advance ``n`` steps of a big board with the HBM-resident packed
     row-tiled kernel: one packed read + write pass per step — 1/32nd the
-    bandwidth of the int32 tiled kernel (``pallas_life.life_step_tiled``)."""
+    bandwidth of an unpacked int32 row-tiled stencil."""
     ny, _ = board.shape
     dtype = board.dtype
     packed = pack_board(board)

@@ -14,13 +14,11 @@ compute layer is Mosaic-compiled Pallas:
   vectorised on the VPU.
 * ``life_step_padded_pallas`` — one stencil step over a halo-padded block,
   used as the per-shard kernel inside the ``shard_map`` halo path.
-* ``life_step_tiled`` — int32 HBM row-tiled stencil: a 1-D grid of
-  programs DMAs overlapping row-tiles (tile + one ghost row each side,
-  torus rows resolved modulo ny) into VMEM scratch. Superseded for
-  big boards by the packed ``bitlife`` tiled kernel (1/32nd the
-  bandwidth); its unaligned ghost-row DMA slices also only lower in
-  interpret mode, so the production dispatch no longer reaches it on
-  hardware.
+
+(An earlier int32 HBM row-tiled stencil lived here; it was superseded by
+the packed ``bitlife`` tiled kernel — 1/32nd the bandwidth — and its
+unaligned ghost-row DMA slices only lowered in interpret mode, so the
+family was removed rather than maintained as dead code.)
 
 All are bit-exact against the NumPy oracle (integer 0/1 state). On
 non-TPU backends the kernels run in Pallas interpret mode so CPU tests
@@ -28,8 +26,6 @@ exercise the same code path.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -50,12 +46,6 @@ def _interpret() -> bool:
 def fits_vmem(shape: tuple[int, int]) -> bool:
     ny, nx = shape
     return ny * nx * 4 <= _VMEM_BYTES_LIMIT
-
-
-def tiled_supported(shape: tuple[int, int]) -> bool:
-    """Row tiling needs at least one row (plus ghosts) under the tile cap;
-    ultra-wide boards (a single int32 row near the VMEM budget) can't."""
-    return (1 << 21) // (4 * shape[1]) - 2 >= 1
 
 
 def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -89,99 +79,8 @@ def _run_roll_fallback(board, n):
     return lax.fori_loop(0, n, lambda _, b: life_ops.life_step_roll(b), board)
 
 
-def _tile_rows(ny: int, nx: int, max_tile_bytes: int = 1 << 21) -> int:
-    """Largest divisor of ``ny`` keeping a (rows+2, nx) int32 tile under
-    ``max_tile_bytes`` (falls back to 1-row tiles; ny is always divisible)."""
-    cap = max(1, max_tile_bytes // (4 * nx) - 2)
-    best = 1
-    for d in range(1, ny + 1):
-        if ny % d == 0 and d <= cap:
-            best = d
-    return best
-
-
-def _tiled_torus_kernel(hbm_ref, out_ref, scratch, sems):
-    """One program = one (Tr, nx) output tile; ghosts fetched mod ny."""
-    i = pl.program_id(0)
-    tr = out_ref.shape[0]
-    ny, nx = hbm_ref.shape
-    row0 = i * tr
-    top = lax.rem(row0 - 1 + ny, ny)
-    bot = lax.rem(row0 + tr, ny)
-    copies = [
-        pltpu.make_async_copy(
-            hbm_ref.at[pl.ds(row0, tr)], scratch.at[pl.ds(1, tr)], sems.at[0]
-        ),
-        pltpu.make_async_copy(
-            hbm_ref.at[pl.ds(top, 1)], scratch.at[pl.ds(0, 1)], sems.at[1]
-        ),
-        pltpu.make_async_copy(
-            hbm_ref.at[pl.ds(bot, 1)], scratch.at[pl.ds(tr + 1, 1)], sems.at[2]
-        ),
-    ]
-    for c in copies:
-        c.start()
-    for c in copies:
-        c.wait()
-    b = scratch[:]
-    rows = b[:-2, :] + b[1:-1, :] + b[2:, :]  # y-sums on the padded tile
-    n = rows + pltpu.roll(rows, 1, 1) + pltpu.roll(rows, nx - 1, 1) - b[1:-1, :]
-    out_ref[:] = life_ops.life_rule(b[1:-1, :], n)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _step_tiled_jit(board_i32: jnp.ndarray, *, interpret: bool):
-    ny, nx = board_i32.shape
-    tr = _tile_rows(ny, nx)
-    return pl.pallas_call(
-        _tiled_torus_kernel,
-        grid=(ny // tr,),
-        out_shape=jax.ShapeDtypeStruct((ny, nx), board_i32.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(
-            (tr, nx), lambda i: (i, 0), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((tr + 2, nx), board_i32.dtype),
-            pltpu.SemaphoreType.DMA((3,)),
-        ],
-        interpret=interpret,
-    )(board_i32)
-
-
-def life_step_tiled(board: jnp.ndarray) -> jnp.ndarray:
-    """One torus step of an HBM-resident board via the row-tiled kernel."""
-    dtype = board.dtype
-    out = _step_tiled_jit(board.astype(jnp.int32), interpret=_interpret())
-    return out.astype(dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _run_tiled_jit(board_i32: jnp.ndarray, steps: jnp.ndarray, *, interpret: bool):
-    return lax.fori_loop(
-        0,
-        steps[0],
-        lambda _, b: _step_tiled_jit(b, interpret=interpret),
-        board_i32,
-    )
-
-
 def _padded_step_kernel(p_ref, out_ref):
     out_ref[:] = life_ops.life_step_padded(p_ref[:])
-
-
-def _tiled_padded_kernel(hbm_ref, out_ref, scratch, sem):
-    """Row-tiled variant for halo-padded blocks too large for VMEM: ghosts
-    are already present in the input (no wrap), so each program just DMAs
-    its (tr+2, W) row window and stencils by slicing."""
-    i = pl.program_id(0)
-    tr = out_ref.shape[0]
-    cp = pltpu.make_async_copy(
-        hbm_ref.at[pl.ds(i * tr, tr + 2)], scratch, sem
-    )
-    cp.start()
-    cp.wait()
-    out_ref[:] = life_ops.life_step_padded(scratch[:])
 
 
 def life_step_padded_pallas(padded: jnp.ndarray) -> jnp.ndarray:
@@ -197,8 +96,7 @@ def life_step_padded_pallas(padded: jnp.ndarray) -> jnp.ndarray:
         # Over-VMEM blocks take the compiled jnp stencil: a halo-padded
         # block has odd dims by construction, and the explicit-DMA row
         # tiling that would stream it needs sublane/lane-aligned slices on
-        # real Mosaic (``_step_tiled_padded`` stays for interpret-mode
-        # coverage of the kernel body).
+        # real Mosaic.
         return life_ops.life_step_padded(padded)
     p32 = padded.astype(jnp.int32)
     out = pl.pallas_call(
@@ -209,23 +107,3 @@ def life_step_padded_pallas(padded: jnp.ndarray) -> jnp.ndarray:
         interpret=_interpret(),
     )(p32)
     return out.astype(dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _step_tiled_padded(p32: jnp.ndarray, *, interpret: bool):
-    h, w = p32.shape[0] - 2, p32.shape[1] - 2
-    tr = _tile_rows(h, w + 2)
-    return pl.pallas_call(
-        _tiled_padded_kernel,
-        grid=(h // tr,),
-        out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(
-            (tr, w), lambda i: (i, 0), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((tr + 2, w + 2), jnp.int32),
-            pltpu.SemaphoreType.DMA(()),
-        ],
-        interpret=interpret,
-    )(p32)

@@ -13,3 +13,9 @@ from mpi_and_open_mp_tpu.parallel.halo import (  # noqa: F401
     ring_perm,
 )
 from mpi_and_open_mp_tpu.parallel import fabric  # noqa: F401
+from mpi_and_open_mp_tpu.parallel.context import (  # noqa: F401
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+    AXIS_SP,
+)

@@ -1,0 +1,247 @@
+"""Long-context sequence/context parallelism: ring attention + Ulysses.
+
+The reference's 1-D ring domain decomposition with neighbour halo exchange
+(``/root/reference/3-life/life_mpi.c:103,150-176,198-209``) is structurally
+the communication pattern of ring attention: a ring of peers, each owning a
+contiguous slab of one long axis, streaming boundary/block state to the next
+peer. This module makes that correspondence concrete — the framework's
+first-class long-context layer, built on the exact same primitives as the
+Life halo exchange (``parallel.halo.ring_perm`` + ``lax.ppermute`` inside
+``shard_map`` over a named mesh axis):
+
+* ``ring_attention`` — sequence-sharded attention where K/V blocks rotate
+  around the ring, one hop per step, combined with an online-softmax
+  (flash-style) running max/sum so the full score matrix never materialises.
+  Comm rides ICI ``ppermute`` exactly like the ghost-row exchange; compute
+  per hop is a dense (n_local x n_local) block that maps onto the MXU.
+* ``ulysses_attention`` — the all-to-all alternative: ``lax.all_to_all``
+  re-shards from sequence-parallel to head-parallel, runs full local
+  attention per head group, and all-to-alls back. Two collectives total
+  instead of ``p`` hops; the better choice when heads >= devices and the
+  fabric favours large transposes.
+
+Both are differentiable (static ring trip count => ``fori_loop`` lowers to
+``scan``), accept any float dtype, and accumulate in float32. Parity oracle:
+``attention_reference`` on the gathered sequence.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+from mpi_and_open_mp_tpu.parallel.halo import ring_perm
+
+AXIS_SP = "sp"
+
+# Finite "minus infinity" for masked scores: large enough that exp() of a
+# masked-vs-unmasked gap underflows to 0, small enough that NEG - NEG = 0
+# stays exact (avoids the -inf - -inf = nan trap in the online softmax).
+_NEG = -1e30
+
+
+def attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False
+) -> jnp.ndarray:
+    """Plain single-device softmax attention — the parity oracle.
+
+    Shapes ``(heads, seq, head_dim)``; float32 softmax regardless of input
+    dtype, result cast back to ``q.dtype``.
+    """
+    h, n, d = q.shape
+    s = jnp.einsum(
+        "hqd,hkd->hqk", q, k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(d))
+    if causal:
+        qpos = jnp.arange(n)[:, None]
+        kpos = jnp.arange(n)[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("hqk,hkd->hqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _block_update(q32, k, v, mask, o, m, l):
+    """One online-softmax accumulation of a K/V block into (o, m, l).
+
+    ``mask`` is boolean ``(hq, nq, nk)`` (or None = all allowed). Running
+    state: ``o`` (hq, nq, d) unnormalised output, ``m`` (hq, nq) running max,
+    ``l`` (hq, nq) running denominator — all float32.
+    """
+    d = q32.shape[-1]
+    s = jnp.einsum(
+        "hqd,hkd->hqk", q32, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * (1.0 / math.sqrt(d))
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = p * mask  # exp(NEG - NEG) = 1 on fully-masked rows; zero it
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "hqk,hkd->hqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return o, m_new, l
+
+
+def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
+    """Per-shard body (inside ``shard_map``): rotate K/V around the ring.
+
+    Each of the ``p`` hops computes one (n_local x n_local) score block and
+    folds it into the online softmax; K/V then move one hop forward — the
+    attention analogue of the ghost-row ``ppermute`` at
+    ``parallel/halo.py:halo_pad_y`` (reference: ``3-life/life_mpi.c:203-207``).
+    """
+    p = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    h, nl, d = q.shape
+    q32 = q.astype(jnp.float32)
+    o0 = jnp.zeros((h, nl, d), jnp.float32)
+    m0 = jnp.full((h, nl), _NEG, jnp.float32)
+    l0 = jnp.zeros((h, nl), jnp.float32)
+    perm = ring_perm(p, 1)
+
+    def fold(j, o, m, l, kb, vb):
+        # After j forward rotations my K/V block originated on ring
+        # position (idx - j) mod p.
+        src = (idx - j) % p
+        if not causal:
+            return _block_update(q32, kb, vb, None, o, m, l)
+        qpos = idx * nl + jnp.arange(nl)
+        kpos = src * nl + jnp.arange(nl)
+        mask = jnp.broadcast_to(qpos[:, None] >= kpos[None, :], (h, nl, nl))
+        # Blocks entirely in the future (src > idx) contribute nothing;
+        # skip their matmul+exp instead of computing and masking it out
+        # (~(p-1)/2 of the hops on average). The predicate is uniform
+        # across the ring and cond is reverse-mode differentiable, so the
+        # scan lowering is unaffected.
+        return lax.cond(
+            src <= idx,
+            lambda args: _block_update(q32, args[0], args[1], mask,
+                                       args[2], args[3], args[4]),
+            lambda args: (args[2], args[3], args[4]),
+            (kb, vb, o, m, l),
+        )
+
+    def hop(j, carry):
+        o, m, l, kb, vb = carry
+        o, m, l = fold(j, o, m, l, kb, vb)
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return o, m, l, kb, vb
+
+    # p-1 compute+rotate hops, then a final fold with no trailing rotation
+    # (the p-th ppermute pair would only feed discarded loop carries).
+    o, m, l, kb, vb = lax.fori_loop(0, p - 1, hop, (o0, m0, l0, k, v))
+    o, m, l = fold(p - 1, o, m, l, kb, vb)
+    o = o / jnp.where(l > 0, l, 1.0)[..., None]
+    return o.astype(q.dtype)
+
+
+def _seq_spec(axis: str) -> P:
+    return P(None, axis, None)
+
+
+def _check_seq(n: int, p: int, what: str) -> None:
+    if n % p:
+        raise ValueError(
+            f"{what}: sequence length {n} not divisible by mesh size {p}; "
+            "pad the sequence to a multiple (the framework's uneven-board "
+            "handling pads globally the same way)"
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("local_fn", "mesh", "axis", "causal")
+)
+def _sharded_attention_jit(q, k, v, *, local_fn, mesh: Mesh, axis: str,
+                           causal: bool):
+    """Shared jit + ``shard_map`` scaffold for both attention variants;
+    ``local_fn`` is the module-level per-shard body (hashable, so the jit
+    cache keys stably on it)."""
+    body = functools.partial(local_fn, axis=axis, causal=causal)
+    spec = _seq_spec(axis)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh | None = None,
+    axis: str = AXIS_SP,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Sequence-parallel attention over a ring mesh axis.
+
+    ``q, k, v``: ``(heads, seq, head_dim)`` with ``seq`` sharded over
+    ``axis``. Peak memory per device is O(seq/p * seq/p) scores for one hop
+    — long contexts scale with the ring size. Returns the same sharding.
+    """
+    if mesh is None:
+        mesh = mesh_lib.make_mesh_1d(axis=axis)
+    _check_seq(q.shape[1], mesh.shape[axis], "ring_attention")
+    sharding = NamedSharding(mesh, _seq_spec(axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return _sharded_attention_jit(q, k, v, local_fn=_ring_attention_local,
+                                  mesh=mesh, axis=axis, causal=causal)
+
+
+def _ulysses_local(q, k, v, *, axis: str, causal: bool):
+    """Per-shard body: all-to-all seq->head re-shard, local attention, back.
+
+    ``lax.all_to_all`` is the third collective family the framework maps onto
+    ICI (after ``ppermute`` halos and ``psum`` reductions); the reference has
+    no direct analogue — its closest structure is the gather/scatter pair of
+    ``life_collect`` (``5-gather/life_mpi.c:178``) done symmetrically by all
+    peers at once.
+    """
+    # (H, n_local, d) -> (H/p, n_global, d): scatter heads, gather sequence.
+    qh = lax.all_to_all(q, axis, split_axis=0, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis, split_axis=0, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis, split_axis=0, concat_axis=1, tiled=True)
+    oh = attention_reference(qh, kh, vh, causal=causal)
+    # (H/p, n_global, d) -> (H, n_local, d).
+    return lax.all_to_all(oh, axis, split_axis=1, concat_axis=0, tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh | None = None,
+    axis: str = AXIS_SP,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """All-to-all (Ulysses-style) sequence-parallel attention.
+
+    Requires ``heads`` divisible by the mesh size (each device computes full
+    attention for ``heads/p`` heads). Two ``all_to_all`` collectives per
+    call instead of ring hops; exact softmax, no online accumulation needed.
+    """
+    if mesh is None:
+        mesh = mesh_lib.make_mesh_1d(axis=axis)
+    p = mesh.shape[axis]
+    _check_seq(q.shape[1], p, "ulysses_attention")
+    if q.shape[0] % p:
+        raise ValueError(
+            f"ulysses_attention: {q.shape[0]} heads not divisible by mesh "
+            f"size {p}; use ring_attention (no head constraint) instead"
+        )
+    sharding = NamedSharding(mesh, _seq_spec(axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return _sharded_attention_jit(q, k, v, local_fn=_ulysses_local,
+                                  mesh=mesh, axis=axis, causal=causal)
