@@ -54,29 +54,21 @@ def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     The board is bit-packed (32 cells/uint32 word — see ``ops.bitlife``):
     packed boards up to ~3200² stay VMEM-resident with the whole step loop
     in one kernel launch (interpret-mode on CPU, so tests exercise the
-    production dispatch); bigger boards on TPU run the packed HBM
-    row-tiled kernel at 1/32nd the bandwidth of an int32 stencil. ``n`` is
-    a runtime scalar (SMEM) — changing it does not recompile.
+    production dispatch); bigger aligned boards run the multi-step-fused
+    tiled kernel (one HBM pass per up-to-128 steps); anything else takes
+    the compiled-XLA packed loop (any shape, any backend). ``n`` is a
+    runtime scalar — changing it does not recompile any path.
     """
     from mpi_and_open_mp_tpu.ops import bitlife
 
     if bitlife.fits_vmem_packed(board.shape):
         return bitlife.life_run_vmem_bits(board, n, interpret=_interpret())
-    if not _interpret() and bitlife.tiled_bits_supported(board.shape):
-        # Big boards in interpret mode skip to the compiled XLA fallback
-        # below — interpret-mode Pallas at that size is impractical.
-        return bitlife.life_run_tiled_bits(board, n)
-    # Remaining cases — lane-unaligned or ultra-wide big boards, and any
-    # big board in interpret mode — get the natively-compiled XLA roll
-    # loop: explicit-DMA row tiling needs a 128-aligned lane dim on real
-    # Mosaic (see bitlife.tiled_bits_supported), and interpret-mode
-    # Pallas is orders of magnitude too slow.
-    return _run_roll_fallback(board, jnp.int32(n)).astype(board.dtype)
-
-
-@jax.jit
-def _run_roll_fallback(board, n):
-    return lax.fori_loop(0, n, lambda _, b: life_ops.life_step_roll(b), board)
+    if not _interpret() and bitlife.fused_bits_supported(board.shape):
+        # Interpret-mode Pallas at big-board sizes is impractical; CPU
+        # takes the XLA loop below (the fused kernel itself is covered in
+        # interpret mode by tests at small aligned shapes).
+        return bitlife.life_run_fused_bits(board, n)
+    return bitlife.life_run_bits_xla(board, n)
 
 
 def _padded_step_kernel(p_ref, out_ref):
@@ -87,8 +79,8 @@ def life_step_padded_pallas(padded: jnp.ndarray) -> jnp.ndarray:
     """Pallas version of ``ops.life_step_padded``: step the interior of a
     halo-padded ``(h+2, w+2)`` block, returning ``(h, w)``.
 
-    Blocks beyond the VMEM budget switch to a row-tiled grid so per-shard
-    sizes of 8192²-class boards work on the shard_map path too.
+    Blocks beyond the VMEM budget take the compiled jnp stencil instead
+    (``life_ops.life_step_padded``) — see the comment below.
     """
     h, w = padded.shape[0] - 2, padded.shape[1] - 2
     dtype = padded.dtype

@@ -122,9 +122,10 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
         mask = jnp.broadcast_to(qpos[:, None] >= kpos[None, :], (h, nl, nl))
         # Blocks entirely in the future (src > idx) contribute nothing;
         # skip their matmul+exp instead of computing and masking it out
-        # (~(p-1)/2 of the hops on average). The predicate is uniform
-        # across the ring and cond is reverse-mode differentiable, so the
-        # scan lowering is unaffected.
+        # (~(p-1)/2 of the hops on average). The predicate differs per
+        # device (idx-dependent), so neither branch may contain a
+        # collective — the ppermutes stay outside, in the hop body. cond
+        # is reverse-mode differentiable; the scan lowering is unaffected.
         return lax.cond(
             src <= idx,
             lambda args: _block_update(q32, args[0], args[1], mask,

@@ -1,10 +1,10 @@
 """Bit-packed kernel parity vs the NumPy oracle (SURVEY §4 mechanism 1).
 
-The packed layout has three hazard zones the shapes below target: the
-word-crossing single-bit shifts (ny straddling multiples of 32), the
-offset-ghost torus wrap rows, and the tile seams of the HBM row-tiled
-variant (forced with tiny ``max_tile_bytes``). All runs are interpret-mode
-Pallas on CPU — the same kernel code Mosaic compiles on TPU.
+The packed layout has two hazard zones the shapes below target: the
+word-crossing single-bit shifts (ny straddling multiples of 32) and the
+offset-ghost torus wrap rows. The Pallas runs are interpret-mode on CPU —
+the same kernel code Mosaic compiles on TPU; the XLA packed loop is the
+identical compiled path used on every backend.
 """
 
 import numpy as np
@@ -54,29 +54,82 @@ def test_vmem_bits_glider_torus():
     assert got.sum() == 5
 
 
-@pytest.mark.parametrize(
-    "ny,nx,mtb",
-    [(300, 33, 3200), (257, 16, 1600), (600, 9, 900), (700, 20, 2000)],
-)
-def test_tiled_bits_parity_multitile(ny, nx, mtb):
-    """Forced 8-word-row tiles over >8-word boards: exercises tile seams
-    and the padded junk words of ``_tiled_bits_kernel`` (nwp > nw for
-    several of these shapes)."""
+@pytest.mark.parametrize("ny,nx", SHAPES + [(300, 33), (257, 16), (600, 9)])
+def test_bits_xla_parity(ny, nx):
+    """The compiled-XLA packed loop (big-board dispatch target) across
+    word-boundary and multi-word shapes."""
     b = _soup(ny, nx, seed=1)
-    got = np.asarray(
-        bitlife.life_run_tiled_bits(
-            jnp.asarray(b), 5, interpret=True, max_tile_bytes=mtb
-        )
-    )
+    got = np.asarray(bitlife.life_run_bits_xla(jnp.asarray(b), 5))
     assert np.array_equal(got, _oracle(b, 5)), (ny, nx)
 
 
-def test_tiled_bits_parity_single_tile():
-    b = _soup(64, 24, seed=2)
+def test_bits_xla_glider_torus():
+    b = np.zeros((10, 10), np.uint8)
+    for j, i in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+        b[j, i] = 1
+    got = np.asarray(bitlife.life_run_bits_xla(jnp.asarray(b), 100))
+    assert np.array_equal(got, _oracle(b, 100))
+    assert got.sum() == 5
+
+
+@pytest.mark.parametrize("ny,nx,steps", [(256, 128, 7), (512, 256, 33)])
+def test_fused_bits_parity(ny, nx, steps):
+    """The multi-step-fused tiled kernel (big-board dispatch target on
+    TPU), interpret mode at small aligned shapes: exercises the
+    word-aligned wrap halo and in-window multi-step validity."""
+    b = _soup(ny, nx, seed=3)
+    assert bitlife.fused_bits_supported((ny, nx))
     got = np.asarray(
-        bitlife.life_run_tiled_bits(jnp.asarray(b), 6, interpret=True)
+        bitlife.life_run_fused_bits(jnp.asarray(b), steps, interpret=True)
     )
-    assert np.array_equal(got, _oracle(b, 6))
+    assert np.array_equal(got, _oracle(b, steps)), (ny, nx, steps)
+
+
+@pytest.mark.parametrize("steps", [5, 40])
+def test_fused_bits_multitile_seams(steps):
+    """Force grid > 1 via a small tile budget so the per-tile DMA offsets
+    and inter-tile halo seams run in interpret mode (at production sizes
+    they only run compiled on TPU). nw=32 with an 8-word budget -> 4
+    tiles; a seam off-by-one corrupts rows at every 256-row boundary."""
+    b = _soup(1024, 128, seed=6)
+    budget = (8 + 2 * bitlife._FUSE_HALO_WORDS) * 4 * 128
+    assert bitlife._fused_tile_words(32, 128, budget) == 8
+    got = np.asarray(
+        bitlife.life_run_fused_bits(
+            jnp.asarray(b), steps, interpret=True, tile_budget_bytes=budget
+        )
+    )
+    assert np.array_equal(got, _oracle(b, steps)), steps
+
+
+def test_fused_bits_pass_boundary():
+    """Step counts straddling FUSE_MAX_STEPS force a second HBM pass whose
+    input is the first pass's output."""
+    b = _soup(256, 128, seed=4, density=0.3)
+    for steps in (bitlife.FUSE_MAX_STEPS, bitlife.FUSE_MAX_STEPS + 1):
+        got = np.asarray(
+            bitlife.life_run_fused_bits(jnp.asarray(b), steps, interpret=True)
+        )
+        assert np.array_equal(got, _oracle(b, steps)), steps
+
+
+def test_fused_bits_gate():
+    assert bitlife.fused_bits_supported((8192, 8192))
+    assert bitlife.fused_bits_supported((16384, 16384))
+    assert not bitlife.fused_bits_supported((250, 128))  # ny % 32 != 0
+    assert not bitlife.fused_bits_supported((256, 500))  # nx % 128 != 0
+    assert not bitlife.fused_bits_supported((288, 384))  # nw=9: no 8k split
+    with pytest.raises(ValueError, match="fused_bits_supported"):
+        bitlife.life_run_fused_bits(
+            jnp.zeros((288, 384), jnp.uint8), 1, interpret=True
+        )
+
+
+def test_pack_exact_roundtrip():
+    b = _soup(96, 33, seed=5)
+    packed = bitlife.pack_board_exact(jnp.asarray(b))
+    assert packed.shape == (3, 33)
+    assert np.array_equal(np.asarray(bitlife.unpack_board_exact(packed)), b)
 
 
 def test_steps_runtime_scalar_no_retrace():
@@ -89,22 +142,13 @@ def test_steps_runtime_scalar_no_retrace():
     assert f._cache_size() == before
 
 
-def test_tiled_bits_gate_ultrawide():
-    """Ultra-wide boards have no Mosaic-legal in-budget tile split; the
-    dispatch gate must reject them (life_run_vmem then falls back to the
-    compiled XLA roll loop instead of a VMEM-overflowing kernel)."""
-    assert not bitlife.tiled_bits_supported((8192, 131072))
-    assert bitlife.tiled_bits_supported((8192, 8192))
-    # Lane-unaligned nx compiles in interpret mode only; the hardware
-    # dispatch gate must reject it (Mosaic memref_slice lane alignment).
-    assert not bitlife.tiled_bits_supported((8192, 500))
-    # Single-tile boards still need 8-aligned DMA extents on hardware.
-    assert bitlife._tile_words(bitlife.n_words(2048), 2048) % 8 == 0
-    with pytest.raises(ValueError, match="tiled_bits_supported"):
-        bitlife.life_run_tiled_bits(
-            jnp.zeros((40, 12), jnp.uint8), 1, interpret=True,
-            max_tile_bytes=64,
-        )
+def test_bits_xla_steps_runtime_scalar_no_retrace():
+    b = jnp.asarray(_soup(40, 24))
+    f = bitlife._run_bits_xla_jit
+    bitlife.life_run_bits_xla(b, 1)
+    before = f._cache_size()
+    bitlife.life_run_bits_xla(b, 4)
+    assert f._cache_size() == before
 
 
 def test_empty_board_stays_empty():

@@ -36,8 +36,10 @@ def test_vmem_kernel_runtime_step_count_no_recompile(make_board):
 
 
 def test_vmem_fallback_large_board(make_board):
-    big = (1200, 1200)  # > 4 MB int32 -> roll fallback
-    assert not pallas_life.fits_vmem(big)
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    big = (3400, 3400)  # packed bytes > _PACKED_VMEM_LIMIT -> XLA packed loop
+    assert not bitlife.fits_vmem_packed(big)
     b = make_board(*big, density=0.2)
     out = pallas_life.life_run_vmem(jnp.asarray(b), 2)
     np.testing.assert_array_equal(np.asarray(out), oracle_n(b, 2))
