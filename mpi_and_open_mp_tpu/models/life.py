@@ -23,9 +23,17 @@ Instead of per-rank slabs with in-place ghost writes, the global board is ONE
 * ``impl="pallas"``: like ``halo`` but the local stencil is a Pallas TPU
   kernel; single-device meshes use the whole-board-in-VMEM multi-step
   kernel (see ``ops.pallas_life``).
+* ``impl="bitfused"`` (``layout="row"`` only): the scale-out flagship —
+  each ring shard holds a bit-packed slab (``ops.bitlife``), exchanges a
+  4-word (=128-cell-row) halo by ``ppermute``, then runs up to 128 fused
+  steps slab-resident through the fused tiled kernel before the next
+  exchange. One collective round per 128 steps instead of per step; the
+  ICI analogue of the reference's ghost-row Send/Recv
+  (``3-life/life_mpi.c:198-209``) amortised 128-fold.
 
 ``impl="auto"`` picks ``pallas`` on TPU / ``halo`` elsewhere when shapes
-divide, else ``roll``.
+divide, else ``roll`` (``bitfused`` is opt-in: its alignment gates —
+``bitlife.fused_row_sharded_supported`` — are a strict subset).
 
 The run loop preserves the reference's ordering (``3-life/life_mpi.c:51-62``):
 at step ``i``, save a snapshot when ``i % save_steps == 0`` (i.e. *before*
@@ -53,7 +61,7 @@ from mpi_and_open_mp_tpu.utils import vtk as vtk_lib
 from mpi_and_open_mp_tpu.utils.config import LifeConfig
 
 LAYOUTS = ("serial", "row", "col", "cart")
-IMPLS = ("auto", "roll", "halo", "pallas")
+IMPLS = ("auto", "roll", "halo", "pallas", "bitfused")
 
 
 def _layout_spec(layout: str) -> P:
@@ -145,6 +153,22 @@ class LifeSim:
                 f"impl={impl!r} needs board {cfg.shape} divisible by mesh "
                 f"{dict(self.mesh.shape)}; use impl='roll' (uneven shards OK)"
             )
+        if impl == "bitfused":
+            from mpi_and_open_mp_tpu.ops import bitlife
+
+            if layout != "row":
+                raise ValueError(
+                    "impl='bitfused' packs cells along y; only the row-ring "
+                    "layout is supported (col/cart would need lane-packed "
+                    "halos)"
+                )
+            p = self.mesh.shape.get("y", 1)
+            if not bitlife.fused_row_sharded_supported(cfg.shape, p):
+                raise ValueError(
+                    f"impl='bitfused' needs board {cfg.shape} with "
+                    f"ny % {32 * p} == 0, nx % 128 == 0, and a legal tile "
+                    "split per shard; use impl='halo' or 'roll'"
+                )
         self.impl = impl
 
         if impl in ("halo", "pallas") and layout != "serial":
@@ -210,6 +234,9 @@ class LifeSim:
 
     def _build_advance(self) -> Callable[[jnp.ndarray, int], jnp.ndarray]:
         """Return ``advance(board, n)`` running ``n`` steps, jit-cached on ``n``."""
+        if self.impl == "bitfused":
+            return self._build_bitfused_advance()
+
         if self.impl == "pallas" and (
             self.mesh is None or self.mesh.size == 1
         ):
@@ -272,6 +299,60 @@ class LifeSim:
 
         return advance
 
+    def _build_bitfused_advance(self) -> Callable:
+        """Row-sharded packed path: ppermute 4-word halos, fuse <=128 steps.
+
+        Each shard packs its slab once per ``advance`` call (pack/unpack are
+        fused XLA ops, amortised over the whole step budget), then loops:
+        exchange ``_FUSE_HALO_WORDS`` word rows with both ring neighbours,
+        run ``min(rem, FUSE_MAX_STEPS)`` steps slab-resident via the fused
+        tiled kernel, repeat. ``n`` is a runtime scalar — one compiled
+        program serves every segment length.
+        """
+        from mpi_and_open_mp_tpu.ops import bitlife
+
+        mesh = self.mesh
+        spec = _layout_spec("row")
+        ny, nx = self.cfg.shape
+        p = mesh.shape["y"]
+        h = bitlife._FUSE_HALO_WORDS
+        interpret = jax.default_backend() != "tpu"
+        step_call = bitlife.make_fused_stepper(
+            ny // 32 // p, nx, interpret=interpret
+        )
+        dtype = self.dtype
+
+        def shard_fn(block, n):
+            packed = bitlife.pack_board_exact(block)
+
+            def body(carry):
+                q, rem = carry
+                k = jnp.minimum(rem, bitlife.FUSE_MAX_STEPS)
+                # The packed, 32x-amortised ghost-row exchange: the same
+                # ring halo as every other impl, in word rows
+                # (cf. 3-life/life_mpi.c:203-207).
+                ext = halo.halo_pad_y(q, "y", depth=h)
+                return step_call(k.reshape(1), ext), rem - k
+
+            q, _ = lax.while_loop(
+                lambda c: c[1] > 0, body, (packed, jnp.int32(n))
+            )
+            return bitlife.unpack_board_exact(q).astype(dtype)
+
+        smapped = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, P()),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+        @jax.jit
+        def advance(board, n):
+            return smapped(board, jnp.int32(n))
+
+        return advance
+
     # ------------------------------------------------------------ public API
 
     def step(self, n: int = 1) -> None:
@@ -285,9 +366,14 @@ class LifeSim:
         The timing analog of the reference's implicit synchronisation at
         its ``MPI_Wtime`` bracket (``3-life/life_mpi.c:64-67``): JAX
         dispatch is async, so timed sections must end here (or at a host
-        fetch). Unlike :meth:`collect`, no board bytes cross the host link.
+        fetch). Unlike :meth:`collect`, only one board element crosses the
+        host link: ``block_until_ready`` alone has been observed returning
+        early for sharded arrays on tunneled-TPU stacks (step-count-
+        independent timings — the tell), so the fetch anchors the wait to
+        actual completion.
         """
         jax.block_until_ready(self.board)
+        np.asarray(jax.device_get(self.board[:1, :1]))
 
     def reset(self) -> None:
         """Restore the initial board without rebuilding compiled steppers."""

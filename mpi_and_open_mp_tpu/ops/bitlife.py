@@ -305,14 +305,29 @@ def fused_bits_supported(shape: tuple[int, int]) -> bool:
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("ny", "interpret", "tile_budget_bytes")
-)
-def _run_fused_bits_jit(
-    packed, steps, *, ny: int, interpret: bool,
+def fused_row_sharded_supported(shape: tuple[int, int], p: int) -> bool:
+    """Same gates for the row-sharded multi-chip path: each of ``p`` ring
+    shards must hold a word-aligned slab with a legal tile split."""
+    ny, nx = shape
+    return (
+        ny % (32 * p) == 0
+        and nx % 128 == 0
+        and _fused_tile_words(ny // 32 // p, nx) >= 8
+    )
+
+
+def make_fused_stepper(
+    nw: int,
+    nx: int,
+    *,
+    interpret: bool,
     tile_budget_bytes: int = _PACKED_VMEM_LIMIT,
 ):
-    nw, nx = packed.shape
+    """Build ``step_call(k, ext) -> (nw, nx)``: the fused tiled kernel over
+    a wrap-extended ``(nw + 2*_FUSE_HALO_WORDS, nx)`` packed board, running
+    ``k[0]`` fused steps. Shared by the serial big-board runner and the
+    row-sharded multi-chip path (where ``ext``'s halo rows arrive by
+    ``ppermute`` from ring neighbours instead of a local wrap concat)."""
     h = _FUSE_HALO_WORDS
     tr = _fused_tile_words(nw, nx, tile_budget_bytes)
     if tr < 8:
@@ -320,10 +335,10 @@ def _run_fused_bits_jit(
             f"no legal fused tile split for packed shape {(nw, nx)}; gate "
             "callers on fused_bits_supported()"
         )
-    step_call = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_fused_tiles_kernel, tr=tr),
         grid=(nw // tr,),
-        out_shape=jax.ShapeDtypeStruct((nw, nx), packed.dtype),
+        out_shape=jax.ShapeDtypeStruct((nw, nx), jnp.uint32),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -332,10 +347,24 @@ def _run_fused_bits_jit(
             (tr, nx), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((tr + 2 * h, nx), packed.dtype),
+            pltpu.VMEM((tr + 2 * h, nx), jnp.uint32),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_budget_bytes")
+)
+def _run_fused_bits_jit(
+    packed, steps, *, interpret: bool,
+    tile_budget_bytes: int = _PACKED_VMEM_LIMIT,
+):
+    nw, nx = packed.shape
+    h = _FUSE_HALO_WORDS
+    step_call = make_fused_stepper(
+        nw, nx, interpret=interpret, tile_budget_bytes=tile_budget_bytes
     )
 
     def body(carry):
@@ -360,12 +389,11 @@ def life_run_fused_bits(
     steps tile-resident in VMEM. HBM traffic per step drops ~100x vs a
     step-per-pass kernel, which is what the big-board regime is bound by.
     """
-    ny, _ = board.shape
     dtype = board.dtype
     packed = pack_board_exact(board)
     steps = jnp.asarray([n], dtype=jnp.int32)
     out = _run_fused_bits_jit(
-        packed, steps, ny=ny, interpret=interpret,
+        packed, steps, interpret=interpret,
         tile_budget_bytes=tile_budget_bytes,
     )
     return unpack_board_exact(out).astype(dtype)
@@ -378,12 +406,13 @@ def bit_step_xla(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
     """One packed Life step as plain XLA ops (``jnp.roll`` shifts).
 
     The compiled-XLA twin of the Pallas :func:`bit_step`: same ghost
-    refresh, same carry-save rule, lane rolls via ``jnp.roll``. XLA fuses
-    the whole bitwise chain and keeps the loop carry VMEM-resident, which
-    measured 14x faster than a hand-tiled explicit-DMA Pallas kernel on an
-    8192² board (27 vs 2.2 Tcups on v5e) — the compiler already schedules
-    this memory-bound elementwise chain better than manual streaming, and
-    it has no lane-alignment or tile-budget constraints at all.
+    refresh, same carry-save rule, lane rolls via ``jnp.roll``. No
+    lane-alignment or tile-budget constraints at all, and competitive
+    while the packed board stays near VMEM scale (measured v5e, marginal
+    per-step: 41 µs at 8192² vs the fused kernel's 38 µs) — but once XLA
+    must materialise the roll intermediates through HBM it falls off
+    (653 µs vs 242 µs at 16384²), which is why aligned big boards
+    dispatch to :func:`life_run_fused_bits` first.
     """
     p = _refresh_ghosts(p, ny)
     nw = p.shape[0]

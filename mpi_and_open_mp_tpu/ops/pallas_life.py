@@ -7,18 +7,17 @@ compute layer is Mosaic-compiled Pallas:
 * ``life_run_vmem`` — the flagship single-shard dispatcher. Boards up to
   ~3200² bit-pack into VMEM (``ops.bitlife``) with the ENTIRE step loop
   inside one kernel launch, so 10,000 steps cost one dispatch and zero
-  HBM round trips; bigger 128-lane-aligned boards stream through the
-  packed HBM row-tiled kernel; anything else takes the compiled XLA roll
-  loop. Torus wrap everywhere is circular shifting — exactly the
-  reference's ``ind()`` modular indexing (``3-life/life2d.c:9``),
-  vectorised on the VPU.
+  HBM round trips; bigger aligned boards run the multi-step-fused tiled
+  kernel (``bitlife.life_run_fused_bits``); anything else takes the
+  compiled-XLA packed loop (``bitlife.life_run_bits_xla``). Torus wrap
+  everywhere is circular shifting — exactly the reference's ``ind()``
+  modular indexing (``3-life/life2d.c:9``), vectorised on the VPU.
 * ``life_step_padded_pallas`` — one stencil step over a halo-padded block,
   used as the per-shard kernel inside the ``shard_map`` halo path.
 
-(An earlier int32 HBM row-tiled stencil lived here; it was superseded by
-the packed ``bitlife`` tiled kernel — 1/32nd the bandwidth — and its
-unaligned ghost-row DMA slices only lowered in interpret mode, so the
-family was removed rather than maintained as dead code.)
+(Two earlier big-board paths lived here — an int32 explicit-DMA row-tiled
+stencil and an unpacked XLA roll fallback; both were superseded by the
+packed fused/XLA pair above and removed rather than kept as dead code.)
 
 All are bit-exact against the NumPy oracle (integer 0/1 state). On
 non-TPU backends the kernels run in Pallas interpret mode so CPU tests
