@@ -65,6 +65,7 @@ def main(argv=None) -> int:
     best = float("inf")
     for _ in range(3):
         sim.reset()
+        sim.sync()  # absorb reset()'s async host->device transfer
         t0 = time.perf_counter()
         sim.step(STEPS)
         sim.sync()
@@ -86,6 +87,7 @@ def main(argv=None) -> int:
     if sim.impl == "pallas" and best < 1.0:
         mult = 41
         sim.reset()
+        sim.sync()
         t0 = time.perf_counter()
         sim.step(STEPS * mult)
         sim.sync()

@@ -366,14 +366,16 @@ class LifeSim:
         The timing analog of the reference's implicit synchronisation at
         its ``MPI_Wtime`` bracket (``3-life/life_mpi.c:64-67``): JAX
         dispatch is async, so timed sections must end here (or at a host
-        fetch). Unlike :meth:`collect`, only one board element crosses the
-        host link: ``block_until_ready`` alone has been observed returning
-        early for sharded arrays on tunneled-TPU stacks (step-count-
-        independent timings — the tell), so the fetch anchors the wait to
-        actual completion.
+        fetch). For mesh-placed boards ``block_until_ready`` alone has been
+        observed returning early on tunneled-TPU stacks (step-count-
+        independent timings — the tell), so a one-element fetch anchors the
+        wait to actual completion there; single-device boards skip the
+        fetch — blocking works for them and the fetch would cost a full
+        host round trip inside the timing bracket.
         """
         jax.block_until_ready(self.board)
-        np.asarray(jax.device_get(self.board[:1, :1]))
+        if self.sharding is not None:
+            np.asarray(jax.device_get(self.board[:1, :1]))
 
     def reset(self) -> None:
         """Restore the initial board without rebuilding compiled steppers."""
