@@ -7,4 +7,7 @@ adds a real argparse CLI for mesh/layout/impl selection:
 * ``python -m mpi_and_open_mp_tpu.apps.life <cfg>``      ≙ ``life_mpi`` / ``life_cart`` / ``life2d``
 * ``python -m mpi_and_open_mp_tpu.apps.integral <N>``    ≙ ``mpi_integral``
 * ``python -m mpi_and_open_mp_tpu.apps.pingpong``        ≙ ``mpi_send_recv``
+* ``python -m mpi_and_open_mp_tpu.apps.attention``       — beyond-reference: the
+  long-context sequence-parallel layer (``parallel.context``) as a driver
+* ``python -m mpi_and_open_mp_tpu.apps.hello``           ≙ ``hello_world`` / ``send``
 """

@@ -1,0 +1,88 @@
+"""Long-context attention CLI: drive the sequence-parallel layer.
+
+The usable surface over ``parallel.context`` (ring + Ulysses attention) —
+runs one forward pass of the chosen variant on an ``sp`` ring mesh,
+verifies it against the single-device oracle (the same parity discipline
+as the Life engine; skippable for oracle-infeasible lengths), and prints
+elapsed seconds on stdout — the framework's standard timing contract
+(cf. ``3-life/life_mpi.c:64-67``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mpi_and_open_mp_tpu.apps.attention")
+    p.add_argument("--variant", choices=("ring", "ulysses"), default="ring")
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--causal", action="store_true")
+    p.add_argument("--devices", type=int, default=None,
+                   help="sp ring size (default: all local devices)")
+    p.add_argument("--dtype", choices=("float32", "bfloat16"),
+                   default="bfloat16")
+    p.add_argument("--no-check", action="store_true",
+                   help="skip the oracle parity check (long sequences)")
+    p.add_argument("--seed", type=int, default=0)
+    add_platform_args(p)
+    args = p.parse_args(argv)
+    apply_platform_args(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh_1d(args.devices, axis=context.AXIS_SP)
+    fn = (context.ring_attention if args.variant == "ring"
+          else context.ulysses_attention)
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(args.seed)
+    shape = (args.heads, args.seq, args.head_dim)
+    q, k, v = (jnp.asarray(rng.standard_normal(shape), dtype)
+               for _ in range(3))
+
+    out = fn(q, k, v, mesh=mesh, causal=args.causal)  # compile + warm
+    np.asarray(jax.device_get(out[:1, :1, :1]))
+    t0 = time.perf_counter()
+    out = fn(q, k, v, mesh=mesh, causal=args.causal)
+    np.asarray(jax.device_get(out[:1, :1, :1]))
+    elapsed = time.perf_counter() - t0
+
+    if not args.no_check:
+        want = context.attention_reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=args.causal)
+        # On TPU, XLA's default matmul precision feeds the MXU bf16 even
+        # for f32 operands, so differently-ordered reductions legitimately
+        # diverge at the ~1e-3 level; only CPU f32 gets the tight bound.
+        exact = dtype == jnp.float32 and jax.default_backend() != "tpu"
+        tol = 1e-4 if exact else 0.06
+        err = float(np.max(np.abs(
+            np.asarray(out, np.float32) - np.asarray(want))))
+        if err > tol:
+            print(f"PARITY FAIL: max|err|={err:.3g} > {tol}", file=sys.stderr)
+            return 1
+        print(f"parity ok (max|err|={err:.3g})", file=sys.stderr)
+
+    # 2*(softmax QK^T)*V matmuls = 4*h*n^2*d multiply-adds (x0.5 causal).
+    flops = 4 * args.heads * args.seq**2 * args.head_dim
+    if args.causal:
+        flops //= 2
+    print(f"{elapsed:.6f}")
+    print(f"variant={args.variant} seq={args.seq} devices={mesh.size} "
+          f"tflops={flops / elapsed / 1e12:.2f}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

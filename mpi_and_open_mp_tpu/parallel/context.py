@@ -67,6 +67,14 @@ def attention_reference(
     return o.astype(q.dtype)
 
 
+# Per-device q-chunk size: when a shard's local sequence exceeds this, the
+# per-hop fold scans over q chunks (padding non-multiple lengths) so the
+# materialised score block is (heads, _Q_CHUNK, n_local) instead of
+# (heads, n_local, n_local) — long contexts on few devices would otherwise
+# OOM HBM (a 16k-token shard is a 16 GB fp32 score matrix).
+_Q_CHUNK = 512
+
+
 def _block_update(q32, k, v, mask, o, m, l):
     """One online-softmax accumulation of a K/V block into (o, m, l).
 
@@ -103,23 +111,74 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     ``parallel/halo.py:halo_pad_y`` (reference: ``3-life/life_mpi.c:203-207``).
     """
     p = lax.axis_size(axis)
+    if p == 1:
+        # A 1-device ring is just full local attention; the doubly-chunked
+        # local path additionally skips future k blocks under causal.
+        return _attention_chunked(q, k, v, causal)
     idx = lax.axis_index(axis)
     h, nl, d = q.shape
     q32 = q.astype(jnp.float32)
-    o0 = jnp.zeros((h, nl, d), jnp.float32)
-    m0 = jnp.full((h, nl), _NEG, jnp.float32)
-    l0 = jnp.zeros((h, nl), jnp.float32)
     perm = ring_perm(p, 1)
+
+    # Flash-style q chunking whenever the shard is long: q rows are
+    # independent, so pad them to a chunk multiple (padded rows compute
+    # junk that is sliced off at the end) — no divisibility cliff.
+    chunked = nl > _Q_CHUNK
+    nc = -(-nl // _Q_CHUNK)
+    nlp = nc * _Q_CHUNK if chunked else nl
+    if chunked and nlp != nl:
+        q32 = jnp.pad(q32, ((0, 0), (0, nlp - nl), (0, 0)))
+    o0 = jnp.zeros((h, nlp, d), jnp.float32)
+    m0 = jnp.full((h, nlp), _NEG, jnp.float32)
+    l0 = jnp.zeros((h, nlp), jnp.float32)
 
     def fold(j, o, m, l, kb, vb):
         # After j forward rotations my K/V block originated on ring
         # position (idx - j) mod p.
         src = (idx - j) % p
-        if not causal:
-            return _block_update(q32, kb, vb, None, o, m, l)
-        qpos = idx * nl + jnp.arange(nl)
         kpos = src * nl + jnp.arange(nl)
-        mask = jnp.broadcast_to(qpos[:, None] >= kpos[None, :], (h, nl, nl))
+
+        def compute(args):
+            kb, vb, o, m, l = args
+            if not chunked:
+                if causal:
+                    qpos = idx * nl + jnp.arange(nl)
+                    mask = jnp.broadcast_to(
+                        qpos[:, None] >= kpos[None, :], (h, nl, nl))
+                else:
+                    mask = None
+                return _block_update(q32, kb, vb, mask, o, m, l)
+            # Scan q (and its running state) in (h, _Q_CHUNK) slices so
+            # only a (h, _Q_CHUNK, nl) score block is ever live.
+
+            def to_chunks(x):
+                return x.reshape(
+                    h, nc, _Q_CHUNK, *x.shape[2:]).swapaxes(0, 1)
+
+            def from_chunks(x):
+                y = x.swapaxes(0, 1)
+                return y.reshape(h, nlp, *y.shape[3:])
+
+            def body(_, xs):
+                qc, oc, mc, lc, ci = xs
+                if causal:
+                    qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(_Q_CHUNK)
+                    mask = jnp.broadcast_to(
+                        qpos[:, None] >= kpos[None, :], (h, _Q_CHUNK, nl))
+                else:
+                    mask = None
+                oc, mc, lc = _block_update(qc, kb, vb, mask, oc, mc, lc)
+                return None, (oc, mc, lc)
+
+            _, (os_, ms, ls) = lax.scan(
+                body, None,
+                (to_chunks(q32), to_chunks(o), to_chunks(m), to_chunks(l),
+                 jnp.arange(nc)),
+            )
+            return from_chunks(os_), from_chunks(ms), from_chunks(ls)
+
+        if not causal:
+            return compute((kb, vb, o, m, l))
         # Blocks entirely in the future (src > idx) contribute nothing;
         # skip their matmul+exp instead of computing and masking it out
         # (~(p-1)/2 of the hops on average). The predicate differs per
@@ -128,8 +187,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
         # is reverse-mode differentiable; the scan lowering is unaffected.
         return lax.cond(
             src <= idx,
-            lambda args: _block_update(q32, args[0], args[1], mask,
-                                       args[2], args[3], args[4]),
+            compute,
             lambda args: (args[2], args[3], args[4]),
             (kb, vb, o, m, l),
         )
@@ -145,8 +203,75 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     # (the p-th ppermute pair would only feed discarded loop carries).
     o, m, l, kb, vb = lax.fori_loop(0, p - 1, hop, (o0, m0, l0, k, v))
     o, m, l = fold(p - 1, o, m, l, kb, vb)
+    if nlp != nl:
+        o, l = o[:, :nl], l[:, :nl]
     o = o / jnp.where(l > 0, l, 1.0)[..., None]
     return o.astype(q.dtype)
+
+
+def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
+    """Full local attention, flash-style double chunking (exact softmax).
+
+    Scans q AND k/v in ``_Q_CHUNK`` slices so only a ``(h, _Q_CHUNK,
+    _Q_CHUNK)`` score block is ever live; causal k blocks entirely in a q
+    chunk's future are skipped via ``cond`` (halving the long-context
+    FLOPs, like the ring path's hop skipping). Non-multiple sequence
+    lengths are padded — padded k positions are masked out, padded q rows
+    are computed and discarded — so there is no divisibility cliff. Used
+    by the Ulysses path and by single-device rings.
+    """
+    h, n, d = q.shape
+    if n <= _Q_CHUNK:
+        return attention_reference(q, k, v, causal=causal)
+    c = _Q_CHUNK
+    nc = -(-n // c)
+    pad = nc * c - n
+    q32 = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, pad), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    qs = q32.reshape(h, nc, c, d).swapaxes(0, 1)
+    ks = kp.reshape(h, nc, c, d).swapaxes(0, 1)
+    vs = vp.reshape(h, nc, c, d).swapaxes(0, 1)
+
+    def body_q(_, xs):
+        qc, ci = xs
+        qpos = ci * c + jnp.arange(c)
+
+        def body_k(carry, ys):
+            oc, mc, lc = carry
+            kb, vb, kj = ys
+            kpos = kj * c + jnp.arange(c)
+            valid = kpos[None, :] < n
+            if causal:
+                valid = valid & (qpos[:, None] >= kpos[None, :])
+            mask = jnp.broadcast_to(valid, (h, c, c))
+
+            def upd(args):
+                return _block_update(qc, args[0], args[1], mask,
+                                     args[2], args[3], args[4])
+
+            if causal:
+                # Skip k blocks entirely in this q chunk's future.
+                oc, mc, lc = lax.cond(
+                    kj <= ci, upd,
+                    lambda args: (args[2], args[3], args[4]),
+                    (kb, vb, oc, mc, lc),
+                )
+            else:
+                oc, mc, lc = upd((kb, vb, oc, mc, lc))
+            return (oc, mc, lc), None
+
+        o0 = jnp.zeros((h, c, d), jnp.float32)
+        m0 = jnp.full((h, c), _NEG, jnp.float32)
+        l0 = jnp.zeros((h, c), jnp.float32)
+        (oc, _, lc), _ = lax.scan(
+            body_k, (o0, m0, l0), (ks, vs, jnp.arange(nc)))
+        oc = oc / jnp.where(lc > 0, lc, 1.0)[..., None]
+        return None, oc
+
+    _, os_ = lax.scan(body_q, None, (qs, jnp.arange(nc)))
+    out = os_.swapaxes(0, 1).reshape(h, nc * c, d)[:, :n, :]
+    return out.astype(q.dtype)
 
 
 def _seq_spec(axis: str) -> P:
@@ -214,7 +339,7 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool):
     qh = lax.all_to_all(q, axis, split_axis=0, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis, split_axis=0, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis, split_axis=0, concat_axis=1, tiled=True)
-    oh = attention_reference(qh, kh, vh, causal=causal)
+    oh = _attention_chunked(qh, kh, vh, causal=causal)
     # (H/p, n_global, d) -> (H, n_local, d).
     return lax.all_to_all(oh, axis, split_axis=1, concat_axis=0, tiled=True)
 

@@ -59,6 +59,20 @@ def test_integral_cli_truncate_32bit(capsys):
     assert rc == 0  # 2^32+1 -> 1 trapezoid after truncation
 
 
+def test_attention_cli(capsys):
+    from mpi_and_open_mp_tpu.apps import attention
+
+    for variant in ("ring", "ulysses"):
+        rc = attention.main([
+            "--variant", variant, "--seq", "256", "--heads", "8",
+            "--head-dim", "16", "--causal", "--dtype", "float32",
+        ])
+        assert rc == 0
+        out = capsys.readouterr()
+        float(out.out.strip().splitlines()[0])  # elapsed-seconds contract
+        assert "parity ok" in out.err
+
+
 def test_pingpong_cli(tmp_path, capsys):
     out_csv = tmp_path / "out.csv"
     rc = pingpong_app.main(

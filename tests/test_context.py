@@ -128,6 +128,95 @@ def test_ulysses_heads_not_divisible_raises(rng, sp_mesh):
         ulysses_attention(q, k, v, mesh=sp_mesh)
 
 
+@pytest.fixture
+def small_chunks(monkeypatch):
+    """Shrink _Q_CHUNK so the chunked paths run at test sizes, and clear
+    the jit caches: the global is baked in at trace time and is NOT part
+    of the cache key, so a stale trace from an unpatched test with the
+    same signature would silently bypass the chunked code."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    def set_chunk(n):
+        monkeypatch.setattr(context, "_Q_CHUNK", n)
+        jax.clear_caches()
+
+    yield set_chunk
+    jax.clear_caches()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_chunked_parity(rng, sp_mesh, causal, small_chunks):
+    small_chunks(16)  # n_local = 64 -> 4 chunks of 16
+    q, k, v = _qkv(rng, 2, 512, 16)
+    got = ring_attention(q, k, v, mesh=sp_mesh, causal=causal)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_chunked_nondivisible(rng, sp_mesh, small_chunks):
+    """n_local = 72 is not a multiple of the 16-row chunk: the padded-q
+    path must still match (no divisibility cliff)."""
+    small_chunks(16)
+    q, k, v = _qkv(rng, 2, 576, 16)
+    got = ring_attention(q, k, v, mesh=sp_mesh, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_attention_chunked_parity(rng, sp_mesh, small_chunks):
+    small_chunks(32)  # n_global = 512 -> 16 chunks
+    q, k, v = _qkv(rng, 8, 512, 16)
+    got = ulysses_attention(q, k, v, mesh=sp_mesh, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_attention_chunked_nondivisible(rng, sp_mesh, small_chunks):
+    """n_global = 520 pads to a chunk multiple; padded k positions must be
+    masked out of the softmax, padded q rows discarded."""
+    small_chunks(32)
+    q, k, v = _qkv(rng, 8, 520, 16)
+    got = ulysses_attention(q, k, v, mesh=sp_mesh, causal=False)
+    want = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_single_device_ring_delegates_chunked(rng, small_chunks):
+    """p=1 rings take the doubly-chunked local path (with causal k-block
+    skipping) — parity incl. a non-multiple length."""
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+    small_chunks(16)
+    mesh1 = mesh_lib.make_mesh_1d(1, axis="sp")
+    for n in (64, 72):
+        q, k, v = _qkv(rng, 2, n, 8)
+        got = ring_attention(q, k, v, mesh=mesh1, causal=True)
+        want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_chunked_grad_parity(rng, sp_mesh, small_chunks):
+    small_chunks(16)
+    q, k, v = _qkv(rng, 2, 256, 8)
+
+    def loss_sharded(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=sp_mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_got = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_ring_attention_default_mesh(rng):
     q, k, v = _qkv(rng, 2, 64, 8)
     got = ring_attention(q, k, v, causal=False)
