@@ -156,17 +156,24 @@ class LifeSim:
         if impl == "bitfused":
             from mpi_and_open_mp_tpu.ops import bitlife
 
-            if layout != "row":
+            if layout == "row":
+                p = self.mesh.shape.get("y", 1)
+                ok = bitlife.fused_row_sharded_supported(cfg.shape, p)
+            elif layout == "cart":
+                py = self.mesh.shape.get("y", 1)
+                px = self.mesh.shape.get("x", 1)
+                ok = bitlife.fused_cart_sharded_supported(cfg.shape, py, px)
+            else:
                 raise ValueError(
-                    "impl='bitfused' packs cells along y; only the row-ring "
-                    "layout is supported (col/cart would need lane-packed "
-                    "halos)"
+                    "impl='bitfused' packs cells along y; supported layouts "
+                    "are the row ring and the cart 2-D mesh (col would need "
+                    "lane-packed halos)"
                 )
-            p = self.mesh.shape.get("y", 1)
-            if not bitlife.fused_row_sharded_supported(cfg.shape, p):
+            if not ok:
                 raise ValueError(
                     f"impl='bitfused' needs board {cfg.shape} with "
-                    f"ny % {32 * p} == 0, nx % 128 == 0, and a legal tile "
+                    f"32*mesh_y-aligned rows, 128-aligned shard columns "
+                    f"(mesh {dict(self.mesh.shape)}), and a legal tile "
                     "split per shard; use impl='halo' or 'roll'"
                 )
         self.impl = impl
@@ -300,26 +307,37 @@ class LifeSim:
         return advance
 
     def _build_bitfused_advance(self) -> Callable:
-        """Row-sharded packed path: ppermute 4-word halos, fuse <=128 steps.
+        """Packed scale-out path: ppermute packed halos, fuse <=128 steps.
 
         Each shard packs its slab once per ``advance`` call (pack/unpack are
         fused XLA ops, amortised over the whole step budget), then loops:
-        exchange ``_FUSE_HALO_WORDS`` word rows with both ring neighbours,
-        run ``min(rem, FUSE_MAX_STEPS)`` steps slab-resident via the fused
-        tiled kernel, repeat. ``n`` is a runtime scalar — one compiled
-        program serves every segment length.
+        exchange ``_FUSE_HALO_WORDS`` word rows (row layout; plus
+        ``_FUSE_HALO_X`` columns first on the cart mesh — corners ride the
+        y-exchange of the x-extended slab, the reference's 2-phase trick at
+        ``6-cartesian/life_cart.c:275-279``), run ``min(rem,
+        FUSE_MAX_STEPS)`` steps slab-resident via the fused tiled kernel,
+        repeat. ``n`` is a runtime scalar — one compiled program serves
+        every segment length.
         """
         from mpi_and_open_mp_tpu.ops import bitlife
 
         mesh = self.mesh
-        spec = _layout_spec("row")
+        spec = _layout_spec(self.layout)
         ny, nx = self.cfg.shape
-        p = mesh.shape["y"]
+        py = mesh.shape.get("y", 1)
         h = bitlife._FUSE_HALO_WORDS
         interpret = jax.default_backend() != "tpu"
-        step_call = bitlife.make_fused_stepper(
-            ny // 32 // p, nx, interpret=interpret
-        )
+        if self.layout == "cart":
+            px = mesh.shape.get("x", 1)
+            step_call = bitlife.make_fused_stepper(
+                ny // 32 // py, nx // px, interpret=interpret,
+                halo_x=bitlife._FUSE_HALO_X,
+            )
+        else:
+            step_call = bitlife.make_fused_stepper(
+                ny // 32 // py, nx, interpret=interpret
+            )
+        cart = self.layout == "cart"
         dtype = self.dtype
 
         def shard_fn(block, n):
@@ -331,7 +349,9 @@ class LifeSim:
                 # The packed, 32x-amortised ghost-row exchange: the same
                 # ring halo as every other impl, in word rows
                 # (cf. 3-life/life_mpi.c:203-207).
-                ext = halo.halo_pad_y(q, "y", depth=h)
+                extx = (halo.halo_pad_x(q, "x", depth=bitlife._FUSE_HALO_X)
+                        if cart else q)
+                ext = halo.halo_pad_y(extx, "y", depth=h)
                 return step_call(k.reshape(1), ext), rem - k
 
             q, _ = lax.while_loop(

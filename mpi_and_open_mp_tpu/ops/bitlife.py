@@ -255,26 +255,32 @@ def _fused_window_step(w: jnp.ndarray, nx: int) -> jnp.ndarray:
     return _carry_save_rule(w, up, dn, nx, lambda x, s: pltpu.roll(x, s, 1))
 
 
-def _fused_tiles_kernel(k_ref, hbm_ref, out_ref, scratch, sem, *, tr: int):
-    """One program = one (tr, nx) output tile, ``k_ref[0]`` fused steps.
+def _fused_tiles_kernel(
+    k_ref, hbm_ref, out_ref, scratch, sem, *, tr: int, hx: int = 0
+):
+    """One program = one (tr, nxl) output tile, ``k_ref[0]`` fused steps.
 
     DMAs the tile plus ``_FUSE_HALO_WORDS`` halo word rows per side from
     the wrap-extended board, steps the whole window k times in VMEM, and
     writes back only the (still-valid) interior — one HBM read+write pass
-    per k steps instead of per step.
+    per k steps instead of per step. ``hx`` > 0 is the 2-D cart case: the
+    input additionally carries ``hx`` halo columns per side (corner cells
+    arrive via the y-exchange of the x-extended slab) and the output
+    slices them off — ``hx`` is a multiple of 128, so the value-level
+    lane slice is vreg-clean.
     """
     i = pl.program_id(0)
     h = _FUSE_HALO_WORDS
-    nx = hbm_ref.shape[1]
+    w_ext = hbm_ref.shape[1]
     cp = pltpu.make_async_copy(
         hbm_ref.at[pl.ds(i * tr, tr + 2 * h)], scratch, sem
     )
     cp.start()
     cp.wait()
     w = lax.fori_loop(
-        0, k_ref[0], lambda _, x: _fused_window_step(x, nx), scratch[:]
+        0, k_ref[0], lambda _, x: _fused_window_step(x, w_ext), scratch[:]
     )
-    out_ref[:] = w[h : h + tr, :]
+    out_ref[:] = w[h : h + tr, hx : w_ext - hx]
 
 
 def _fused_tile_words(
@@ -316,38 +322,63 @@ def fused_row_sharded_supported(shape: tuple[int, int], p: int) -> bool:
     )
 
 
+# Column halo for the 2-D (cart) fused path: 128 lanes = 128 cell columns
+# per side, matching FUSE_MAX_STEPS (x junk marches 1 column per step).
+_FUSE_HALO_X = 128
+
+
+def fused_cart_sharded_supported(
+    shape: tuple[int, int], py: int, px: int
+) -> bool:
+    """Gates for the 2-D cart bitfused path: word-aligned y slabs,
+    128-aligned x slabs (also ensures the halo slice fits the shard), and
+    a legal tile split at the halo-extended width."""
+    ny, nx = shape
+    if ny % (32 * py) or nx % px:
+        return False
+    nxl = nx // px
+    return (
+        nxl % 128 == 0
+        and _fused_tile_words(ny // 32 // py, nxl + 2 * _FUSE_HALO_X) >= 8
+    )
+
+
 def make_fused_stepper(
     nw: int,
-    nx: int,
+    nxl: int,
     *,
     interpret: bool,
     tile_budget_bytes: int = _PACKED_VMEM_LIMIT,
+    halo_x: int = 0,
 ):
-    """Build ``step_call(k, ext) -> (nw, nx)``: the fused tiled kernel over
-    a wrap-extended ``(nw + 2*_FUSE_HALO_WORDS, nx)`` packed board, running
-    ``k[0]`` fused steps. Shared by the serial big-board runner and the
-    row-sharded multi-chip path (where ``ext``'s halo rows arrive by
-    ``ppermute`` from ring neighbours instead of a local wrap concat)."""
+    """Build ``step_call(k, ext) -> (nw, nxl)``: the fused tiled kernel
+    over a wrap-extended ``(nw + 2*_FUSE_HALO_WORDS, nxl + 2*halo_x)``
+    packed board, running ``k[0]`` fused steps. Shared by the serial
+    big-board runner, the row-sharded ring path (``halo_x=0``; halo rows
+    arrive by ``ppermute`` instead of a local wrap concat), and the 2-D
+    cart path (``halo_x=_FUSE_HALO_X`` halo columns per side)."""
     h = _FUSE_HALO_WORDS
-    tr = _fused_tile_words(nw, nx, tile_budget_bytes)
+    w_ext = nxl + 2 * halo_x
+    tr = _fused_tile_words(nw, w_ext, tile_budget_bytes)
     if tr < 8:
         raise ValueError(
-            f"no legal fused tile split for packed shape {(nw, nx)}; gate "
-            "callers on fused_bits_supported()"
+            f"no legal fused tile split for extended shape {(nw, w_ext)}; "
+            "gate callers on fused_bits_supported() / "
+            "fused_cart_sharded_supported()"
         )
     return pl.pallas_call(
-        functools.partial(_fused_tiles_kernel, tr=tr),
+        functools.partial(_fused_tiles_kernel, tr=tr, hx=halo_x),
         grid=(nw // tr,),
-        out_shape=jax.ShapeDtypeStruct((nw, nx), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((nw, nxl), jnp.uint32),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (tr, nx), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (tr, nxl), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((tr + 2 * h, nx), jnp.uint32),
+            pltpu.VMEM((tr + 2 * h, w_ext), jnp.uint32),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,

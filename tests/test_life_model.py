@@ -87,16 +87,34 @@ def test_bitfused_segmented_run_and_debug(make_board, tmp_path):
     assert len(list(tmp_path.glob("*.vtk"))) == 3  # steps 0, 4, 8
 
 
+@pytest.mark.parametrize("steps", [5, 130])
+def test_parity_bitfused_cart_mesh(make_board, steps):
+    """The 2-D cart bitfused path: 128-column x halo + 4-word y halo per
+    round (corners via the sequenced exchange), <=128 fused steps. The
+    4x2 mesh gives 256x128 shards; 130 steps crosses a round boundary."""
+    board = make_board(1024, 256, density=0.35)
+    mesh = mesh_lib.make_mesh_2d(4, 2)
+    cfg = config_from_board(board, steps=steps, save_steps=1000)
+    sim = LifeSim(cfg, layout="cart", impl="bitfused", mesh=mesh)
+    sim.step(steps)
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, steps))
+
+
 def test_bitfused_gates(make_board):
-    with pytest.raises(ValueError, match="row-ring"):
+    with pytest.raises(ValueError, match="lane-packed"):
         LifeSim(config_from_board(make_board(2048, 128), 1, 1),
-                layout="cart", impl="bitfused")
+                layout="col", impl="bitfused")
+    # cart shard columns must be 128-aligned: 256/2 ok, 192/2 = 96 not.
+    with pytest.raises(ValueError, match="128-aligned"):
+        LifeSim(config_from_board(make_board(1024, 192), 1, 1),
+                layout="cart", impl="bitfused",
+                mesh=mesh_lib.make_mesh_2d(4, 2))
     # ny not divisible by 32*p (8 devices): 2040 % 256 != 0.
-    with pytest.raises(ValueError, match="legal tile split|ny %"):
+    with pytest.raises(ValueError, match="32\\*mesh_y-aligned"):
         LifeSim(config_from_board(make_board(2040, 128), 1, 1),
                 layout="row", impl="bitfused")
     # nx not 128-aligned.
-    with pytest.raises(ValueError, match="nx % 128"):
+    with pytest.raises(ValueError, match="128-aligned shard columns"):
         LifeSim(config_from_board(make_board(2048, 120), 1, 1),
                 layout="row", impl="bitfused")
 
