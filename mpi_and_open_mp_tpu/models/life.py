@@ -23,17 +23,20 @@ Instead of per-rank slabs with in-place ghost writes, the global board is ONE
 * ``impl="pallas"``: like ``halo`` but the local stencil is a Pallas TPU
   kernel; single-device meshes use the whole-board-in-VMEM multi-step
   kernel (see ``ops.pallas_life``).
-* ``impl="bitfused"`` (``layout="row"`` only): the scale-out flagship —
-  each ring shard holds a bit-packed slab (``ops.bitlife``), exchanges a
-  4-word (=128-cell-row) halo by ``ppermute``, then runs up to 128 fused
-  steps slab-resident through the fused tiled kernel before the next
-  exchange. One collective round per 128 steps instead of per step; the
-  ICI analogue of the reference's ghost-row Send/Recv
-  (``3-life/life_mpi.c:198-209``) amortised 128-fold.
+* ``impl="bitfused"`` (row/col/cart): the scale-out flagship — each
+  shard holds a bit-packed slab (``ops.bitlife``), exchanges a
+  4-word (=128-cell-row) y halo and/or a 128-column x halo by
+  ``ppermute`` (unsharded axes wrap locally; cart corners ride the
+  sequenced exchange), then runs up to 128 fused steps slab-resident
+  through the fused tiled kernel before the next exchange. One
+  collective round per 128 steps instead of per step; the ICI analogue
+  of the reference's ghost Send/Recv (``3-life/life_mpi.c:198-209``,
+  ``4-life:197-208``) amortised 128-fold.
 
 ``impl="auto"`` picks ``pallas`` on TPU / ``halo`` elsewhere when shapes
 divide, else ``roll`` (``bitfused`` is opt-in: its alignment gates —
-``bitlife.fused_row_sharded_supported`` — are a strict subset).
+``bitlife.fused_row_sharded_supported`` for the row ring,
+``fused_cart_sharded_supported`` for col/cart — are a strict subset).
 
 The run loop preserves the reference's ordering (``3-life/life_mpi.c:51-62``):
 at step ``i``, save a snapshot when ``i % save_steps == 0`` (i.e. *before*
@@ -156,19 +159,17 @@ class LifeSim:
         if impl == "bitfused":
             from mpi_and_open_mp_tpu.ops import bitlife
 
-            if layout == "row":
-                p = self.mesh.shape.get("y", 1)
-                ok = bitlife.fused_row_sharded_supported(cfg.shape, p)
-            elif layout == "cart":
-                py = self.mesh.shape.get("y", 1)
-                px = self.mesh.shape.get("x", 1)
-                ok = bitlife.fused_cart_sharded_supported(cfg.shape, py, px)
-            else:
+            if layout == "serial":
                 raise ValueError(
-                    "impl='bitfused' packs cells along y; supported layouts "
-                    "are the row ring and the cart 2-D mesh (col would need "
-                    "lane-packed halos)"
+                    "impl='bitfused' needs a sharded layout (row/col/cart); "
+                    "serial big boards already take the fused kernel via "
+                    "impl='pallas'"
                 )
+            py, px = _mesh_divisors(layout, self.mesh)
+            if layout == "row":
+                ok = bitlife.fused_row_sharded_supported(cfg.shape, py)
+            else:  # col is the py=1 cart case (y wrap is shard-local)
+                ok = bitlife.fused_cart_sharded_supported(cfg.shape, py, px)
             if not ok:
                 raise ValueError(
                     f"impl='bitfused' needs board {cfg.shape} with "
@@ -324,20 +325,15 @@ class LifeSim:
         mesh = self.mesh
         spec = _layout_spec(self.layout)
         ny, nx = self.cfg.shape
-        py = mesh.shape.get("y", 1)
+        py, px = _mesh_divisors(self.layout, mesh)
         h = bitlife._FUSE_HALO_WORDS
         interpret = jax.default_backend() != "tpu"
-        if self.layout == "cart":
-            px = mesh.shape.get("x", 1)
-            step_call = bitlife.make_fused_stepper(
-                ny // 32 // py, nx // px, interpret=interpret,
-                halo_x=bitlife._FUSE_HALO_X,
-            )
-        else:
-            step_call = bitlife.make_fused_stepper(
-                ny // 32 // py, nx, interpret=interpret
-            )
-        cart = self.layout == "cart"
+        x_sharded = self.layout in ("col", "cart")
+        y_sharded = self.layout in ("row", "cart")
+        step_call = bitlife.make_fused_stepper(
+            ny // 32 // py, nx // px, interpret=interpret,
+            halo_x=bitlife._FUSE_HALO_X if x_sharded else 0,
+        )
         dtype = self.dtype
 
         def shard_fn(block, n):
@@ -346,12 +342,18 @@ class LifeSim:
             def body(carry):
                 q, rem = carry
                 k = jnp.minimum(rem, bitlife.FUSE_MAX_STEPS)
-                # The packed, 32x-amortised ghost-row exchange: the same
-                # ring halo as every other impl, in word rows
-                # (cf. 3-life/life_mpi.c:203-207).
-                extx = (halo.halo_pad_x(q, "x", depth=bitlife._FUSE_HALO_X)
-                        if cart else q)
-                ext = halo.halo_pad_y(extx, "y", depth=h)
+                # The packed, 32x-amortised ghost exchange: the same ring
+                # halos as every other impl, in word rows / lane columns
+                # (cf. 3-life/life_mpi.c:203-207, 4-life:197-208). Axes
+                # the mesh doesn't shard wrap locally — same content, no
+                # collective.
+                extx = (halo.halo_pad_x(q, "x", bitlife._FUSE_HALO_X)
+                        if x_sharded else q)
+                if y_sharded:
+                    ext = halo.halo_pad_y(extx, "y", h)
+                else:
+                    ext = jnp.concatenate(
+                        [extx[-h:], extx, extx[:h]], axis=0)
                 return step_call(k.reshape(1), ext), rem - k
 
             q, _ = lax.while_loop(

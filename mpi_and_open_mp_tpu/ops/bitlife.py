@@ -332,7 +332,8 @@ def fused_cart_sharded_supported(
 ) -> bool:
     """Gates for the 2-D cart bitfused path: word-aligned y slabs,
     128-aligned x slabs (also ensures the halo slice fits the shard), and
-    a legal tile split at the halo-extended width."""
+    a legal tile split at the halo-extended width. The column-strip
+    layout is the ``py=1`` case (y wrap becomes a local concat)."""
     ny, nx = shape
     if ny % (32 * py) or nx % px:
         return False

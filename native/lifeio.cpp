@@ -127,4 +127,85 @@ void lifeio_life_steps(uint8_t *board, long long nx, long long ny,
     }
 }
 
+namespace {
+
+// out[i] = v[(i-1+nx) % nx] over a 64-cells/word packed row.
+void shift_toward_higher(const std::uint64_t *v, std::uint64_t *out,
+                         long long W, long long nx, std::uint64_t last_mask) {
+    for (long long w = 0; w < W; ++w)
+        out[w] = (v[w] << 1) | (w ? (v[w - 1] >> 63) : 0);
+    out[0] |= (v[W - 1] >> ((nx - 1) & 63)) & 1ULL;  // torus wrap
+    out[W - 1] &= last_mask;
+}
+
+// out[i] = v[(i+1) % nx].
+void shift_toward_lower(const std::uint64_t *v, std::uint64_t *out,
+                        long long W, long long nx, std::uint64_t last_mask) {
+    for (long long w = 0; w < W; ++w)
+        out[w] = (v[w] >> 1) | (w + 1 < W ? (v[w + 1] << 63) : 0);
+    out[W - 1] &= last_mask;
+    out[W - 1] |= (v[0] & 1ULL) << ((nx - 1) & 63);  // torus wrap
+}
+
+}  // namespace
+
+// Bit-packed serial oracle: 64 cells per uint64 along x, carry-save-adder
+// rule — the host twin of the TPU kernels' bitwise algorithm
+// (mpi_and_open_mp_tpu/ops/bitlife.py), ~50x the scalar oracle above on
+// big boards. Kept as a SECOND independent native implementation; tests
+// cross-check it against both the scalar path and the NumPy oracle.
+void lifeio_life_steps_bits(uint8_t *board, long long nx, long long ny,
+                            long long steps) {
+    const long long W = (nx + 63) / 64;
+    const std::uint64_t last_mask =
+        (nx % 64) ? ((1ULL << (nx % 64)) - 1) : ~0ULL;
+    std::vector<std::uint64_t> cur(static_cast<size_t>(W * ny), 0);
+    std::vector<std::uint64_t> nxt(static_cast<size_t>(W * ny), 0);
+    for (long long j = 0; j < ny; ++j)
+        for (long long i = 0; i < nx; ++i)
+            if (board[j * nx + i])
+                cur[j * W + i / 64] |= 1ULL << (i % 64);
+
+    std::vector<std::uint64_t> v0(W), v1(W), l0(W), r0(W), l1(W), r1(W);
+    for (long long s = 0; s < steps; ++s) {
+        for (long long j = 0; j < ny; ++j) {
+            const std::uint64_t *up = &cur[((j - 1 + ny) % ny) * W];
+            const std::uint64_t *mid = &cur[j * W];
+            const std::uint64_t *dn = &cur[((j + 1) % ny) * W];
+            for (long long w = 0; w < W; ++w) {
+                std::uint64_t a = up[w], b = mid[w], c = dn[w];
+                v0[w] = a ^ b ^ c;                  // vertical triple sum,
+                v1[w] = (a & b) | (c & (a ^ b));    // 2-bit carry-save
+            }
+            shift_toward_higher(v0.data(), l0.data(), W, nx, last_mask);
+            shift_toward_lower(v0.data(), r0.data(), W, nx, last_mask);
+            shift_toward_higher(v1.data(), l1.data(), W, nx, last_mask);
+            shift_toward_lower(v1.data(), r1.data(), W, nx, last_mask);
+            std::uint64_t *out = &nxt[j * W];
+            for (long long w = 0; w < W; ++w) {
+                std::uint64_t t0 = l0[w] ^ v0[w] ^ r0[w];
+                std::uint64_t k0 =
+                    (l0[w] & v0[w]) | (r0[w] & (l0[w] ^ v0[w]));
+                std::uint64_t u0 = l1[w] ^ v1[w] ^ r1[w];
+                std::uint64_t u1 =
+                    (l1[w] & v1[w]) | (r1[w] & (l1[w] ^ v1[w]));
+                std::uint64_t t1 = u0 ^ k0;
+                std::uint64_t vc = u0 & k0;
+                std::uint64_t t2 = u1 ^ vc;
+                std::uint64_t t3 = u1 & vc;
+                // alive' = T==3 | (alive & T==4), T includes the centre.
+                std::uint64_t is3 = t0 & t1 & ~t2 & ~t3;
+                std::uint64_t is4 = ~t0 & ~t1 & t2 & ~t3;
+                out[w] = (is3 | (mid[w] & is4)) &
+                         (w == W - 1 ? last_mask : ~0ULL);
+            }
+        }
+        cur.swap(nxt);
+    }
+    for (long long j = 0; j < ny; ++j)
+        for (long long i = 0; i < nx; ++i)
+            board[j * nx + i] =
+                static_cast<uint8_t>((cur[j * W + i / 64] >> (i % 64)) & 1);
+}
+
 }  // extern "C"

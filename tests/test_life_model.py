@@ -88,6 +88,17 @@ def test_bitfused_segmented_run_and_debug(make_board, tmp_path):
 
 
 @pytest.mark.parametrize("steps", [5, 130])
+def test_parity_bitfused_col_strips(make_board, steps):
+    """Column-strip bitfused: 128-column ppermute halos along x, local
+    y wrap (the py=1 cart case). 8 shards of 1024x128."""
+    board = make_board(1024, 1024, density=0.35)
+    cfg = config_from_board(board, steps=steps, save_steps=1000)
+    sim = LifeSim(cfg, layout="col", impl="bitfused")
+    sim.step(steps)
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, steps))
+
+
+@pytest.mark.parametrize("steps", [5, 130])
 def test_parity_bitfused_cart_mesh(make_board, steps):
     """The 2-D cart bitfused path: 128-column x halo + 4-word y halo per
     round (corners via the sequenced exchange), <=128 fused steps. The
@@ -101,9 +112,9 @@ def test_parity_bitfused_cart_mesh(make_board, steps):
 
 
 def test_bitfused_gates(make_board):
-    with pytest.raises(ValueError, match="lane-packed"):
+    with pytest.raises(ValueError, match="sharded layout"):
         LifeSim(config_from_board(make_board(2048, 128), 1, 1),
-                layout="col", impl="bitfused")
+                layout="serial", impl="bitfused")
     # cart shard columns must be 128-aligned: 256/2 ok, 192/2 = 96 not.
     with pytest.raises(ValueError, match="128-aligned"):
         LifeSim(config_from_board(make_board(1024, 192), 1, 1),

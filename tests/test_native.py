@@ -74,6 +74,21 @@ def test_native_oracle_matches_numpy(make_board):
     )
 
 
+def test_native_bits_oracle_matches(make_board):
+    """The bit-packed native oracle (third independent implementation)
+    must agree with both the scalar C++ and NumPy oracles — including
+    word-boundary widths (63/64/65), sub-word boards, and degenerate
+    torus sizes where neighbours alias (nx or ny in {1, 2})."""
+    from conftest import oracle_n
+
+    for shape in [(10, 10), (17, 23), (48, 63), (48, 64), (48, 65),
+                  (8, 200), (3, 130), (2, 70), (70, 2), (1, 9), (9, 1)]:
+        b = make_board(*shape)
+        got = native.life_steps(b, 9, bits=True)
+        np.testing.assert_array_equal(got, oracle_n(b, 9), err_msg=str(shape))
+        np.testing.assert_array_equal(got, native.life_steps(b, 9))
+
+
 def test_native_roundtrip_config(tmp_path, make_board):
     board = make_board(9, 9)
     cfg = config_from_board(board, 7, 3)
