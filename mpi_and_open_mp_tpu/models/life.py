@@ -33,10 +33,11 @@ Instead of per-rank slabs with in-place ghost writes, the global board is ONE
   of the reference's ghost Send/Recv (``3-life/life_mpi.c:198-209``,
   ``4-life:197-208``) amortised 128-fold.
 
-``impl="auto"`` picks ``pallas`` on TPU / ``halo`` elsewhere when shapes
-divide, else ``roll`` (``bitfused`` is opt-in: its alignment gates —
-``bitlife.fused_row_sharded_supported`` for the row ring,
-``fused_cart_sharded_supported`` for col/cart — are a strict subset).
+``impl="auto"``: serial boards pick ``pallas`` on TPU / ``roll``
+elsewhere; sharded layouts pick ``bitfused`` on TPU when its alignment
+gates pass (``bitlife.fused_row_sharded_supported`` for the row ring,
+``fused_cart_sharded_supported`` for col/cart), else ``halo`` when
+shapes divide, else ``roll``.
 
 The run loop preserves the reference's ordering (``3-life/life_mpi.c:51-62``):
 at step ``i``, save a snapshot when ``i % save_steps == 0`` (i.e. *before*
@@ -108,6 +109,17 @@ def _ceil_to(n: int, m: int) -> int:
 class LifeSim:
     """One Life run: sharded board state + compiled steppers + snapshot IO."""
 
+    def _bitfused_supported(self, layout: str, shape: tuple[int, int]) -> bool:
+        from mpi_and_open_mp_tpu.ops import bitlife
+
+        if layout == "serial":
+            return False
+        py, px = _mesh_divisors(layout, self.mesh)
+        if layout == "row":
+            return bitlife.fused_row_sharded_supported(shape, py)
+        # col is the py=1 cart case (y wrap is shard-local).
+        return bitlife.fused_cart_sharded_supported(shape, py, px)
+
     def __init__(
         self,
         cfg: LifeConfig,
@@ -138,10 +150,16 @@ class LifeSim:
 
         divisible = _divisible(cfg.shape, layout, self.mesh)
         if impl == "auto":
+            on_tpu = jax.default_backend() == "tpu"
             if layout == "serial":
                 # Pallas only where it compiles natively; elsewhere it would
                 # run in interpret mode, orders of magnitude slower.
-                impl = "pallas" if jax.default_backend() == "tpu" else "roll"
+                impl = "pallas" if on_tpu else "roll"
+            elif on_tpu and self._bitfused_supported(layout, cfg.shape):
+                # Best sharded path when its alignment gates pass: one
+                # collective round per <=128 fused steps. TPU-only — on
+                # CPU the kernel would run in interpret mode.
+                impl = "bitfused"
             elif divisible:
                 impl = "halo"
             else:
@@ -157,20 +175,13 @@ class LifeSim:
                 f"{dict(self.mesh.shape)}; use impl='roll' (uneven shards OK)"
             )
         if impl == "bitfused":
-            from mpi_and_open_mp_tpu.ops import bitlife
-
             if layout == "serial":
                 raise ValueError(
                     "impl='bitfused' needs a sharded layout (row/col/cart); "
                     "serial big boards already take the fused kernel via "
                     "impl='pallas'"
                 )
-            py, px = _mesh_divisors(layout, self.mesh)
-            if layout == "row":
-                ok = bitlife.fused_row_sharded_supported(cfg.shape, py)
-            else:  # col is the py=1 cart case (y wrap is shard-local)
-                ok = bitlife.fused_cart_sharded_supported(cfg.shape, py, px)
-            if not ok:
+            if not self._bitfused_supported(layout, cfg.shape):
                 raise ValueError(
                     f"impl='bitfused' needs board {cfg.shape} with "
                     f"32*mesh_y-aligned rows, 128-aligned shard columns "
@@ -349,11 +360,8 @@ class LifeSim:
                 # collective.
                 extx = (halo.halo_pad_x(q, "x", bitlife._FUSE_HALO_X)
                         if x_sharded else q)
-                if y_sharded:
-                    ext = halo.halo_pad_y(extx, "y", h)
-                else:
-                    ext = jnp.concatenate(
-                        [extx[-h:], extx, extx[:h]], axis=0)
+                ext = (halo.halo_pad_y(extx, "y", h) if y_sharded
+                       else bitlife.wrap_y(extx, h))
                 return step_call(k.reshape(1), ext), rem - k
 
             q, _ = lax.while_loop(

@@ -386,6 +386,14 @@ def make_fused_stepper(
     )
 
 
+def wrap_y(p: jnp.ndarray, h: int = _FUSE_HALO_WORDS) -> jnp.ndarray:
+    """Extend a packed board with ``h`` torus-wrap word rows per side —
+    the local (single-shard / unsharded-axis) form of the fused kernel's
+    y halo. Sharded axes get the same rows via ``ppermute`` instead
+    (``halo.halo_pad_y``); both must honour ``_FUSE_HALO_WORDS``."""
+    return jnp.concatenate([p[-h:], p, p[:h]], axis=0)
+
+
 @functools.partial(
     jax.jit, static_argnames=("interpret", "tile_budget_bytes")
 )
@@ -402,7 +410,7 @@ def _run_fused_bits_jit(
     def body(carry):
         p, rem = carry
         k = jnp.minimum(rem, FUSE_MAX_STEPS)
-        ext = jnp.concatenate([p[-h:], p, p[:h]], axis=0)
+        ext = wrap_y(p, h)
         return step_call(k.reshape(1), ext), rem - k
 
     out, _ = lax.while_loop(
