@@ -58,7 +58,8 @@ def n_words(ny: int) -> int:
 
 def fits_vmem_packed(shape: tuple[int, int]) -> bool:
     ny, nx = shape
-    return n_words(ny) * nx * 4 <= _PACKED_VMEM_LIMIT
+    nxp = -(-nx // 128) * 128  # lane padding (see life_run_vmem_bits)
+    return n_words(ny) * nxp * 4 <= _PACKED_VMEM_LIMIT
 
 
 def pack_board(board: jnp.ndarray) -> jnp.ndarray:
@@ -130,20 +131,21 @@ def _roll_sub(p: jnp.ndarray, shift: int) -> jnp.ndarray:
     return pltpu.roll(p, shift % nw, 0)
 
 
-def _carry_save_rule(c, up, dn, nx: int, roll_lane) -> jnp.ndarray:
+def _carry_save_rule(c, up, dn, roll_left, roll_right) -> jnp.ndarray:
     """The bitwise Life rule given centre/up/down bit columns.
 
-    ``roll_lane(x, s)`` rolls the lane (x) axis by ``s`` with exact torus
-    wrap at ``nx`` — ``pltpu.roll`` inside Pallas, ``jnp.roll`` in XLA.
+    ``roll_left(x)``/``roll_right(x)`` supply each lane its left/right
+    torus neighbour — plain rolls when the array width IS the board
+    width, rolls + wrap-column fixup on the lane-padded fast path.
     """
     # 2-bit column sums up+centre+down (carry-save adder).
     ys0 = up ^ c ^ dn
     ys1 = (up & c) | (dn & (up ^ c))
-    # x-neighbours: lane rolls with the exact torus wrap at nx.
-    l0 = roll_lane(ys0, 1)
-    r0 = roll_lane(ys0, nx - 1)
-    l1 = roll_lane(ys1, 1)
-    r1 = roll_lane(ys1, nx - 1)
+    # x-neighbours.
+    l0 = roll_left(ys0)
+    r0 = roll_right(ys0)
+    l1 = roll_left(ys1)
+    r1 = roll_right(ys1)
     # T = left + centre + right column sums: 4-bit 9-cell total.
     t0 = l0 ^ ys0 ^ r0
     k0 = (l0 & ys0) | (r0 & (l0 ^ ys0))
@@ -160,16 +162,39 @@ def _carry_save_rule(c, up, dn, nx: int, roll_lane) -> jnp.ndarray:
 
 
 def bit_step(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
-    """One Life step on a packed board (ghost refresh + bitwise rule)."""
+    """One Life step on a packed board (ghost refresh + bitwise rule).
+
+    ``p`` may be lane-padded (``p.shape[1] > nx``): Mosaic lane rolls at
+    a non-128-multiple width cost ~3.4x (measured 401 vs 1376 Gcups at
+    500² vs 512² on v5e), so the runner pads the board to the next lane
+    multiple and the two wrap columns are patched explicitly — slack
+    columns carry junk that never feeds a valid column.
+    """
     p = _refresh_ghosts(p, ny)
-    nw = p.shape[0]
+    nw, nxp = p.shape
     # y-neighbours: single-bit shifts through the packed words. The junk
     # carried into ghost/slack positions never reaches a live bit.
     dn = (p << 1) | (_roll_sub(p, 1) >> 31)
     up = (p >> 1) | (_roll_sub(p, nw - 1) << 31)
-    return _carry_save_rule(
-        p, up, dn, nx, lambda x, s: pltpu.roll(x, s, 1)
-    )
+    if nxp == nx:
+        return _carry_save_rule(
+            p, up, dn,
+            lambda x: pltpu.roll(x, 1, 1),
+            lambda x: pltpu.roll(x, nx - 1, 1),
+        )
+    lane = lax.broadcasted_iota(jnp.int32, (nw, nxp), 1)
+
+    def roll_left(x):
+        # Lane i takes x[i-1]; lane 0's true left neighbour is column
+        # nx-1 (the roll would hand it slack column nxp-1).
+        return jnp.where(lane == 0, x[:, nx - 1 : nx], pltpu.roll(x, 1, 1))
+
+    def roll_right(x):
+        return jnp.where(
+            lane == nx - 1, x[:, 0:1], pltpu.roll(x, nxp - 1, 1)
+        )
+
+    return _carry_save_rule(p, up, dn, roll_left, roll_right)
 
 
 def _vmem_bits_kernel(steps_ref, p_ref, out_ref, *, ny: int, nx: int):
@@ -178,9 +203,8 @@ def _vmem_bits_kernel(steps_ref, p_ref, out_ref, *, ny: int, nx: int):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("ny", "interpret"))
-def _run_vmem_bits_jit(packed, steps, *, ny: int, interpret: bool):
-    nx = packed.shape[1]
+@functools.partial(jax.jit, static_argnames=("ny", "nx", "interpret"))
+def _run_vmem_bits_jit(packed, steps, *, ny: int, nx: int, interpret: bool):
     return pl.pallas_call(
         functools.partial(_vmem_bits_kernel, ny=ny, nx=nx),
         out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
@@ -198,15 +222,20 @@ def life_run_vmem_bits(
 ) -> jnp.ndarray:
     """Advance ``n`` steps with the packed VMEM-resident loop kernel.
 
-    Pack/unpack are plain XLA ops fused around the single kernel launch;
+    The board is lane-padded to the next multiple of 128 columns before
+    packing (see :func:`bit_step` — unaligned lane rolls cost ~3.4x);
+    pack/unpack are plain XLA ops fused around the single kernel launch;
     ``n`` is a runtime SMEM scalar (no recompile when it changes).
     """
-    ny, _ = board.shape
+    ny, nx = board.shape
     dtype = board.dtype
+    nxp = -(-nx // 128) * 128
+    if nxp != nx:
+        board = jnp.pad(board, ((0, 0), (0, nxp - nx)))
     packed = pack_board(board)
     steps = jnp.asarray([n], dtype=jnp.int32)
-    out = _run_vmem_bits_jit(packed, steps, ny=ny, interpret=interpret)
-    return unpack_board(out, ny).astype(dtype)
+    out = _run_vmem_bits_jit(packed, steps, ny=ny, nx=nx, interpret=interpret)
+    return unpack_board(out, ny)[:, :nx].astype(dtype)
 
 
 # ------------------------------------------- big boards (fused tiled Pallas)
@@ -252,7 +281,11 @@ def _fused_window_step(w: jnp.ndarray, nx: int) -> jnp.ndarray:
     outermost bit rows is tracked by the validity argument above)."""
     dn = (w << 1) | (_roll_sub(w, 1) >> 31)
     up = (w >> 1) | (_roll_sub(w, w.shape[0] - 1) << 31)
-    return _carry_save_rule(w, up, dn, nx, lambda x, s: pltpu.roll(x, s, 1))
+    return _carry_save_rule(
+        w, up, dn,
+        lambda x: pltpu.roll(x, 1, 1),
+        lambda x: pltpu.roll(x, nx - 1, 1),
+    )
 
 
 def _fused_tiles_kernel(
@@ -458,7 +491,11 @@ def bit_step_xla(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
     nw = p.shape[0]
     dn = (p << 1) | (jnp.roll(p, 1, 0) >> 31)
     up = (p >> 1) | (jnp.roll(p, nw - 1, 0) << 31)
-    return _carry_save_rule(p, up, dn, nx, lambda x, s: jnp.roll(x, s, 1))
+    return _carry_save_rule(
+        p, up, dn,
+        lambda x: jnp.roll(x, 1, 1),
+        lambda x: jnp.roll(x, nx - 1, 1),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("ny",))

@@ -50,6 +50,23 @@ def test_gosper_gun_emits_gliders():
     assert pop120 == pop0 + 4 * 5
 
 
+def test_gun_full_1000_step_parity():
+    """The gun fixture at its FULL configured step budget (SURVEY §4: the
+    reference's p46gun runs 1000 steps) through the sharded 2-D engine —
+    the longest-horizon parity gate in the suite. By step 1000 the gun's
+    glider stream has wrapped the torus and collided with the gun itself,
+    so this also exercises long-range wrap interactions."""
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+    cfg = load_config_py(os.path.join(CONFIGS, "gun_300x100.cfg"))
+    assert cfg.steps == 1000
+    sim = LifeSim(cfg, layout="cart", impl="halo",
+                  mesh=mesh_lib.make_mesh_2d(4, 2), fuse_steps=4)
+    final = sim.run(save=False)
+    np.testing.assert_array_equal(final, oracle_n(cfg.board(), 1000))
+
+
 def test_mix_still_lifes_stable_block():
     cfg = load_config_py(os.path.join(CONFIGS, "mix_40x20.cfg"))
     b = oracle_n(cfg.board(), 4)
