@@ -501,15 +501,36 @@ class LifeSim:
             jax.device_get(self._advance(self.board, n))
 
     def collect(self) -> np.ndarray:
-        """Gather the global board to the host (uint8 ``(ny, nx)``)."""
-        full = np.asarray(jax.device_get(self.board), dtype=np.uint8)
+        """Gather the global board to the host (uint8 ``(ny, nx)``).
+
+        On multi-host (``jax.distributed``) runs the board is not fully
+        addressable from one process, so the gather goes through a
+        cross-process allgather — every host gets the full board, the
+        multi-host generalisation of the reference's gather-to-root
+        (``5-gather/life_mpi.c:178``).
+        """
+        if self.board.is_fully_addressable:
+            full = np.asarray(jax.device_get(self.board), dtype=np.uint8)
+        else:
+            from jax.experimental import multihost_utils
+
+            full = np.asarray(
+                multihost_utils.process_allgather(self.board, tiled=True),
+                dtype=np.uint8,
+            )
         return full[: self.cfg.ny, : self.cfg.nx]
 
     def save_snapshot(self) -> str:
         assert self.outdir is not None, "LifeSim(outdir=...) required to save"
-        os.makedirs(self.outdir, exist_ok=True)
         path = vtk_lib.vtk_path(self.outdir, self.step_count)
-        vtk_lib.write_vtk(path, self.collect())
+        # collect() is COLLECTIVE on multi-host runs (cross-process
+        # allgather) — every process must enter it; only process 0 writes
+        # the file, the reference's write-from-one-rank discipline
+        # (3-life/life_mpi.c:54-57; shared-FS double-writes otherwise).
+        board = self.collect()
+        if jax.process_index() == 0:
+            os.makedirs(self.outdir, exist_ok=True)
+            vtk_lib.write_vtk(path, board)
         return path
 
     def save_state(self) -> None:

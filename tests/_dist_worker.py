@@ -1,0 +1,65 @@
+"""Worker for test_distributed: one jax.distributed process of a 2-host run.
+
+Run as ``python _dist_worker.py <proc_id> <nprocs> <coordinator>``.
+Prints ``DIST_OK`` from process 0 on success. Kept as a plain script (not
+a test module): it must bootstrap its own JAX runtime before any import
+side effects, which cannot happen inside the already-initialised pytest
+process.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # 1 CPU device per process
+
+proc_id, nprocs, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coord, num_processes=nprocs, process_id=proc_id)
+
+import numpy as np  # noqa: E402
+
+from mpi_and_open_mp_tpu.models.integral import Integral  # noqa: E402
+from mpi_and_open_mp_tpu.models.life import LifeSim  # noqa: E402
+from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy  # noqa: E402
+from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from mpi_and_open_mp_tpu.utils.config import config_from_board  # noqa: E402
+
+assert jax.process_count() == nprocs
+assert len(jax.devices()) == nprocs  # one device per process, DCN-style
+
+# Cross-process psum through the quadrature model.
+mesh = mesh_lib.make_mesh_1d(len(jax.devices()), axis="y")
+val = Integral(1_000_000, mesh=mesh).compute()
+assert abs(val - np.pi) < 1e-3, val
+
+# Sharded Life run whose halo exchange crosses the process boundary;
+# collect() must allgather (the board is not fully addressable).
+rng = np.random.default_rng(0)
+board = (rng.random((64, 40)) < 0.35).astype(np.uint8)
+cfg = config_from_board(board, steps=6, save_steps=0)
+sim = LifeSim(cfg, layout="row", impl="halo", mesh=mesh)
+sim.step(6)
+got = sim.collect()
+ref = board.copy()
+for _ in range(6):
+    ref = life_step_numpy(ref)
+assert np.array_equal(got, ref), "multi-process halo step lost parity"
+
+# Snapshot write: collective collect, process-0-only file write.
+import tempfile  # noqa: E402
+
+sim.outdir = os.path.join(
+    tempfile.gettempdir(), f"dist_vtk_{os.path.basename(coord)}")
+path = sim.save_snapshot()
+if proc_id == 0:
+    from mpi_and_open_mp_tpu.utils.vtk import read_vtk
+    assert np.array_equal(read_vtk(path), got)
+
+jax.distributed.shutdown()
+if proc_id == 0:
+    print("DIST_OK")
