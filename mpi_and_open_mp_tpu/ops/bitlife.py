@@ -289,25 +289,34 @@ def _fused_window_step(w: jnp.ndarray, nx: int) -> jnp.ndarray:
 
 
 def _fused_tiles_kernel(
-    k_ref, hbm_ref, out_ref, scratch, sem, *, tr: int, hx: int = 0
+    k_ref, hbm_ref, out_ref, scratch, sem, *, tr: int, hx: int = 0,
+    cx: int | None = None,
 ):
-    """One program = one (tr, nxl) output tile, ``k_ref[0]`` fused steps.
+    """One program = one (tr, cx-or-full-width) output tile, ``k_ref[0]``
+    fused steps.
 
     DMAs the tile plus ``_FUSE_HALO_WORDS`` halo word rows per side from
     the wrap-extended board, steps the whole window k times in VMEM, and
     writes back only the (still-valid) interior — one HBM read+write pass
-    per k steps instead of per step. ``hx`` > 0 is the 2-D cart case: the
-    input additionally carries ``hx`` halo columns per side (corner cells
-    arrive via the y-exchange of the x-extended slab) and the output
-    slices them off — ``hx`` is a multiple of 128, so the value-level
-    lane slice is vreg-clean.
+    per k steps instead of per step. ``hx`` > 0 means the input carries
+    ``hx`` halo columns per side (from an x wrap or a cart-mesh ppermute;
+    corner cells arrive via the y-exchange of the x-extended slab) and
+    the output slices them off. ``cx`` additionally tiles columns on a
+    2-D grid — each program's window is its column range plus the same
+    ``hx`` border, read from the extended input at a 128-aligned offset.
+    All lane offsets/extents stay 128-aligned, so the value-level x slice
+    is vreg-clean.
     """
     i = pl.program_id(0)
     h = _FUSE_HALO_WORDS
-    w_ext = hbm_ref.shape[1]
-    cp = pltpu.make_async_copy(
-        hbm_ref.at[pl.ds(i * tr, tr + 2 * h)], scratch, sem
-    )
+    if cx is None:
+        w_ext = hbm_ref.shape[1]
+        src = hbm_ref.at[pl.ds(i * tr, tr + 2 * h)]
+    else:
+        j = pl.program_id(1)
+        w_ext = cx + 2 * hx
+        src = hbm_ref.at[pl.ds(i * tr, tr + 2 * h), pl.ds(j * cx, w_ext)]
+    cp = pltpu.make_async_copy(src, scratch, sem)
     cp.start()
     cp.wait()
     w = lax.fori_loop(
@@ -335,13 +344,13 @@ def _fused_tile_words(
 def fused_bits_supported(shape: tuple[int, int]) -> bool:
     """Whether the fused tiled kernel can run ``shape`` compiled: word-
     aligned torus (ny % 32), 128-aligned lane dim (explicit-DMA scratch),
-    and a legal tile split."""
+    and a legal tile split — full-width row tiles or the column-tiled
+    plan (which also covers ultra-wide boards)."""
     ny, nx = shape
-    return (
-        ny % 32 == 0
-        and nx % 128 == 0
-        and _fused_tile_words(ny // 32, nx) >= 8
-    )
+    if ny % 32 or nx % 128:
+        return False
+    nw = ny // 32
+    return _fused_tile_words(nw, nx) >= 8 or _col_tile_plan(nw, nx) is not None
 
 
 def fused_row_sharded_supported(shape: tuple[int, int], p: int) -> bool:
@@ -373,8 +382,30 @@ def fused_cart_sharded_supported(
     nxl = nx // px
     return (
         nxl % 128 == 0
-        and _fused_tile_words(ny // 32 // py, nxl + 2 * _FUSE_HALO_X) >= 8
+        and _col_tile_plan(ny // 32 // py, nxl) is not None
     )
+
+
+def _col_tile_plan(
+    nw: int, nxl: int, tile_budget_bytes: int = _PACKED_VMEM_LIMIT
+):
+    """Best ``(amplification, tr, cx)`` column-tiling plan for an ext
+    carrying ``_FUSE_HALO_X`` borders, or None. Amplification = redundant
+    window area per output area = (tr+2H)/tr * (cx+2HX)/cx; wide boards
+    prefer narrower column tiles (taller row tiles fit the VMEM budget),
+    e.g. 16384-wide drops from 2.0x (tr=8 full-width) to ~1.2x."""
+    best = None
+    for cx in range(128, nxl + 1, 128):
+        if nxl % cx:
+            continue
+        w_ext = cx + 2 * _FUSE_HALO_X
+        tr = _fused_tile_words(nw, w_ext, tile_budget_bytes)
+        if tr < 8:
+            continue
+        amp = (tr + 2 * _FUSE_HALO_WORDS) / tr * (w_ext / cx)
+        if best is None or amp < best[0] - 1e-9:
+            best = (amp, tr, cx)
+    return best
 
 
 def make_fused_stepper(
@@ -389,30 +420,50 @@ def make_fused_stepper(
     over a wrap-extended ``(nw + 2*_FUSE_HALO_WORDS, nxl + 2*halo_x)``
     packed board, running ``k[0]`` fused steps. Shared by the serial
     big-board runner, the row-sharded ring path (``halo_x=0``; halo rows
-    arrive by ``ppermute`` instead of a local wrap concat), and the 2-D
-    cart path (``halo_x=_FUSE_HALO_X`` halo columns per side)."""
+    arrive by ``ppermute`` instead of a local wrap concat), and the x-
+    extended paths (``halo_x=_FUSE_HALO_X``: cart-mesh shards and wide
+    serial boards), which additionally column-tile on a 2-D grid when
+    that lowers the redundant-window amplification."""
     h = _FUSE_HALO_WORDS
     w_ext = nxl + 2 * halo_x
-    tr = _fused_tile_words(nw, w_ext, tile_budget_bytes)
-    if tr < 8:
-        raise ValueError(
-            f"no legal fused tile split for extended shape {(nw, w_ext)}; "
-            "gate callers on fused_bits_supported() / "
-            "fused_cart_sharded_supported()"
-        )
+    if halo_x:
+        plan = _col_tile_plan(nw, nxl, tile_budget_bytes)
+        if plan is None:
+            raise ValueError(
+                f"no legal fused tile split for extended shape "
+                f"{(nw, w_ext)}; gate callers on fused_bits_supported() / "
+                "fused_cart_sharded_supported()"
+            )
+        _, tr, cx = plan
+        grid = (nw // tr, nxl // cx)
+        kernel = functools.partial(
+            _fused_tiles_kernel, tr=tr, hx=halo_x, cx=cx)
+        out_block = pl.BlockSpec(
+            (tr, cx), lambda i, j: (i, j), memory_space=pltpu.VMEM)
+        scratch_w = cx + 2 * halo_x
+    else:
+        tr = _fused_tile_words(nw, nxl, tile_budget_bytes)
+        if tr < 8:
+            raise ValueError(
+                f"no legal fused tile split for packed shape {(nw, nxl)}; "
+                "gate callers on fused_bits_supported()"
+            )
+        grid = (nw // tr,)
+        kernel = functools.partial(_fused_tiles_kernel, tr=tr)
+        out_block = pl.BlockSpec(
+            (tr, nxl), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        scratch_w = nxl
     return pl.pallas_call(
-        functools.partial(_fused_tiles_kernel, tr=tr, hx=halo_x),
-        grid=(nw // tr,),
+        kernel,
+        grid=grid,
         out_shape=jax.ShapeDtypeStruct((nw, nxl), jnp.uint32),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec(
-            (tr, nxl), lambda i: (i, 0), memory_space=pltpu.VMEM
-        ),
+        out_specs=out_block,
         scratch_shapes=[
-            pltpu.VMEM((tr + 2 * h, w_ext), jnp.uint32),
+            pltpu.VMEM((tr + 2 * h, scratch_w), jnp.uint32),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,
@@ -436,13 +487,24 @@ def _run_fused_bits_jit(
 ):
     nw, nx = packed.shape
     h = _FUSE_HALO_WORDS
+    # Pick the less-amplified tiling: full-width row tiles (wrap by lane
+    # roll, no x border) vs column tiles (x-wrap border + 2-D grid).
+    tr_full = _fused_tile_words(nw, nx, tile_budget_bytes)
+    amp_full = ((tr_full + 2 * h) / tr_full if tr_full >= 8
+                else float("inf"))
+    plan = _col_tile_plan(nw, nx, tile_budget_bytes)
+    use_cols = plan is not None and plan[0] < amp_full
+    halo_x = _FUSE_HALO_X if use_cols else 0
     step_call = make_fused_stepper(
-        nw, nx, interpret=interpret, tile_budget_bytes=tile_budget_bytes
+        nw, nx, interpret=interpret, tile_budget_bytes=tile_budget_bytes,
+        halo_x=halo_x,
     )
 
     def body(carry):
         p, rem = carry
         k = jnp.minimum(rem, FUSE_MAX_STEPS)
+        if halo_x:
+            p = jnp.concatenate([p[:, -halo_x:], p, p[:, :halo_x]], axis=1)
         ext = wrap_y(p, h)
         return step_call(k.reshape(1), ext), rem - k
 

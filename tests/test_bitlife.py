@@ -113,9 +113,30 @@ def test_fused_bits_pass_boundary():
         assert np.array_equal(got, _oracle(b, steps)), steps
 
 
+@pytest.mark.parametrize("steps", [5, 40, bitlife.FUSE_MAX_STEPS + 2])
+def test_fused_bits_column_tiled_serial(steps):
+    """Force the serial runner onto the column-tiled 2-D grid (x-wrap
+    border + per-tile column windows) with a budget that rules out
+    full-width row tiles; seams in BOTH axes are exercised, and the
+    largest step count crosses a pass boundary so the inter-pass x-halo
+    re-concat runs."""
+    b = _soup(512, 512, seed=7)
+    budget = 4 * (8 + 8) * (128 + 256)
+    assert bitlife._fused_tile_words(16, 512, budget) < 8
+    plan = bitlife._col_tile_plan(16, 512, budget)
+    assert plan is not None and plan[2] < 512  # genuinely column-tiled
+    got = np.asarray(bitlife.life_run_fused_bits(
+        jnp.asarray(b), steps, interpret=True, tile_budget_bytes=budget))
+    assert np.array_equal(got, _oracle(b, steps)), steps
+
+
 def test_fused_bits_gate():
     assert bitlife.fused_bits_supported((8192, 8192))
     assert bitlife.fused_bits_supported((16384, 16384))
+    # Ultra-wide boards: full-width row tiles don't fit the budget, the
+    # column-tiled plan does.
+    assert bitlife._fused_tile_words(8192 // 32, 131072) < 8
+    assert bitlife.fused_bits_supported((8192, 131072))
     assert not bitlife.fused_bits_supported((250, 128))  # ny % 32 != 0
     assert not bitlife.fused_bits_supported((256, 500))  # nx % 128 != 0
     assert not bitlife.fused_bits_supported((288, 384))  # nw=9: no 8k split

@@ -37,7 +37,15 @@ def test_two_process_distributed_run():
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=180) for p in procs]
+    try:
+        outs = [p.communicate(timeout=180) for p in procs]
+    finally:
+        # A worker hung in a collective would otherwise outlive the test,
+        # holding the coordinator port.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
     assert "DIST_OK" in outs[0][0]
