@@ -85,13 +85,21 @@ def main(argv=None) -> int:
     # without burning minutes of chip time.
     steady = best
     if sim.impl == "pallas":
-        mult = 41 if best < 1.0 else 6
-        sim.reset()
-        sim.sync()
-        t0 = time.perf_counter()
-        sim.step(STEPS * mult)
-        sim.sync()
-        chained = time.perf_counter() - t0
+        # RTT-bound sub-second runs: make the differencing signal large
+        # vs the ~±10 ms RTT jitter (161x chain ≈ 0.3 s of pure compute
+        # at the flagship rate → jitter is <5% of signal) and take
+        # best-of-3. Multi-second big-board runs: jitter is negligible
+        # and a 6x chain already costs real chip time — single shot.
+        rtt_bound = best < 1.0
+        mult, reps = (161, 3) if rtt_bound else (6, 1)
+        chained = float("inf")
+        for _ in range(reps):
+            sim.reset()
+            sim.sync()
+            t0 = time.perf_counter()
+            sim.step(STEPS * mult)
+            sim.sync()
+            chained = min(chained, time.perf_counter() - t0)
         if chained > best:
             steady = (chained - best) / (mult - 1)
     cups = NY * NX * STEPS / best
