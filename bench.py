@@ -74,15 +74,12 @@ def main(argv=None) -> int:
     # Steady-state rate: the single-run number above carries one fixed
     # host->device dispatch round trip (~70 ms on a tunneled axon chip —
     # measured via a scalar fetch; a co-located host pays ~none), which
-    # swamps an 8 ms compute. On the pallas path the step count is a
-    # runtime SMEM scalar, so a single 41x-longer dispatch reuses the same
-    # executable; the difference isolates the marginal per-step rate. The
-    # other impls jit with a static step count (the longer dispatch would
-    # recompile — and on CPU also grind through 41x the steps), so they
-    # just report the end-to-end number.
-    # Big-board runs (seconds, dominated by pack/unpack + transfer rather
-    # than RTT) use a smaller multiplier: enough extra steps for SNR
-    # without burning minutes of chip time.
+    # swamps the few-ms compute. On the pallas path the step count is a
+    # runtime scalar, so a mult-x-longer dispatch reuses the same
+    # executable; differencing the two durations isolates the marginal
+    # per-step rate. The other impls jit with a static step count (the
+    # longer dispatch would recompile — and on CPU also grind through
+    # mult-x the steps), so they just report the end-to-end number.
     steady = best
     if sim.impl == "pallas":
         # RTT-bound sub-second runs: make the differencing signal large

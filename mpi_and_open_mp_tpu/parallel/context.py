@@ -111,10 +111,11 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     ``parallel/halo.py:halo_pad_y`` (reference: ``3-life/life_mpi.c:203-207``).
     """
     p = lax.axis_size(axis)
+    groups = q.shape[0] // k.shape[0]
     if p == 1:
         # A 1-device ring is just full local attention; the doubly-chunked
         # local path additionally skips future k blocks under causal.
-        return _attention_chunked(q, k, v, causal)
+        return _attention_chunked(q, *_repeat_heads(k, v, groups), causal)
     idx = lax.axis_index(axis)
     h, nl, d = q.shape
     q32 = q.astype(jnp.float32)
@@ -140,6 +141,9 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
 
         def compute(args):
             kb, vb, o, m, l = args
+            # GQA: expand K/V heads locally — the ring moved only the
+            # hkv-head blocks.
+            kb, vb = _repeat_heads(kb, vb, groups)
             if not chunked:
                 if causal:
                     qpos = idx * nl + jnp.arange(nl)
@@ -287,6 +291,26 @@ def _check_seq(n: int, p: int, what: str) -> None:
         )
 
 
+def _check_gqa(q, k, what: str) -> int:
+    """Validate GQA/MQA head counts; returns the group count hq // hkv."""
+    hq, hkv = q.shape[0], k.shape[0]
+    if hq % hkv:
+        raise ValueError(
+            f"{what}: {hq} query heads not a multiple of {hkv} kv heads"
+        )
+    return hq // hkv
+
+
+def _repeat_heads(k, v, groups: int):
+    """Broadcast K/V heads across query-head groups — always LOCAL (in
+    VMEM, never on the wire): the ring carries un-expanded K/V around and
+    expands per fold; Ulysses all-to-alls un-expanded K/V when the head
+    count allows."""
+    if groups == 1:
+        return k, v
+    return jnp.repeat(k, groups, axis=0), jnp.repeat(v, groups, axis=0)
+
+
 @functools.partial(
     jax.jit, static_argnames=("local_fn", "mesh", "axis", "causal")
 )
@@ -314,12 +338,14 @@ def ring_attention(
     """Sequence-parallel attention over a ring mesh axis.
 
     ``q, k, v``: ``(heads, seq, head_dim)`` with ``seq`` sharded over
-    ``axis``. Peak memory per device is O(seq/p * seq/p) scores for one hop
-    — long contexts scale with the ring size. Returns the same sharding.
+    ``axis``. K/V may carry fewer heads (GQA/MQA) as long as they divide
+    the query heads. Peak memory per device is O(chunk * seq/p) scores —
+    long contexts scale with the ring size. Returns the same sharding.
     """
     if mesh is None:
         mesh = mesh_lib.make_mesh_1d(axis=axis)
     _check_seq(q.shape[1], mesh.shape[axis], "ring_attention")
+    _check_gqa(q, k, "ring_attention")
     sharding = NamedSharding(mesh, _seq_spec(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return _sharded_attention_jit(q, k, v, local_fn=_ring_attention_local,
@@ -339,6 +365,10 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool):
     qh = lax.all_to_all(q, axis, split_axis=0, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis, split_axis=0, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis, split_axis=0, concat_axis=1, tiled=True)
+    # GQA with hkv % p == 0 reaches here un-expanded (the contiguous
+    # q-head block on each device maps exactly onto its kv-head block);
+    # broadcast across the local groups only now, after the wire.
+    kh, vh = _repeat_heads(kh, vh, qh.shape[0] // kh.shape[0])
     oh = _attention_chunked(qh, kh, vh, causal=causal)
     # (H/p, n_global, d) -> (H, n_local, d).
     return lax.all_to_all(oh, axis, split_axis=1, concat_axis=0, tiled=True)
@@ -356,17 +386,23 @@ def ulysses_attention(
 
     Requires ``heads`` divisible by the mesh size (each device computes full
     attention for ``heads/p`` heads). Two ``all_to_all`` collectives per
-    call instead of ring hops; exact softmax, no online accumulation needed.
+    call instead of ring hops; exact softmax, no online accumulation
+    needed. GQA/MQA K/V heads are broadcast to the query heads first.
     """
     if mesh is None:
         mesh = mesh_lib.make_mesh_1d(axis=axis)
     p = mesh.shape[axis]
     _check_seq(q.shape[1], p, "ulysses_attention")
+    groups = _check_gqa(q, k, "ulysses_attention")
     if q.shape[0] % p:
         raise ValueError(
             f"ulysses_attention: {q.shape[0]} heads not divisible by mesh "
             f"size {p}; use ring_attention (no head constraint) instead"
         )
+    if k.shape[0] % p:
+        # Too few kv heads to split across the mesh — expand before the
+        # all_to_all (the hkv % p == 0 case rides the wire un-expanded).
+        k, v = _repeat_heads(k, v, groups)
     sharding = NamedSharding(mesh, _seq_spec(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return _sharded_attention_jit(q, k, v, local_fn=_ulysses_local,

@@ -217,6 +217,32 @@ def test_ring_attention_chunked_grad_parity(rng, sp_mesh, small_chunks):
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("hkv", [1, 2, 8])
+def test_gqa_kv_head_broadcast(rng, sp_mesh, hkv):
+    """GQA/MQA: fewer K/V heads broadcast across query-head groups, for
+    both variants, vs an oracle fed the explicitly repeated K/V.
+    hkv=8 with hq=16 exercises Ulysses' un-expanded-on-the-wire path
+    (hkv % p == 0); hkv in {1, 2} exercises its pre-expansion fallback
+    and the ring's per-fold local expansion."""
+    hq, n, d = (16, 128, 16) if hkv == 8 else (8, 128, 16)
+    q = jnp.asarray(rng.standard_normal((hq, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    kr = jnp.repeat(k, hq // hkv, axis=0)
+    vr = jnp.repeat(v, hq // hkv, axis=0)
+    want = attention_reference(q, kr, vr, causal=True)
+    for fn in (ring_attention, ulysses_attention):
+        got = fn(q, k, v, mesh=sp_mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_indivisible_heads_raises(rng, sp_mesh):
+    q, k, v = _qkv(rng, 8, 128, 16)
+    with pytest.raises(ValueError, match="not a multiple"):
+        ring_attention(q, k[:3], v[:3], mesh=sp_mesh)
+
+
 def test_ring_attention_default_mesh(rng):
     q, k, v = _qkv(rng, 2, 64, 8)
     got = ring_attention(q, k, v, causal=False)
