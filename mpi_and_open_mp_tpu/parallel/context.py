@@ -75,12 +75,20 @@ def attention_reference(
 _Q_CHUNK = 512
 
 
+@jax.checkpoint
 def _block_update(q32, k, v, mask, o, m, l):
     """One online-softmax accumulation of a K/V block into (o, m, l).
 
     ``mask`` is boolean ``(hq, nq, nk)`` (or None = all allowed). Running
     state: ``o`` (hq, nq, d) unnormalised output, ``m`` (hq, nq) running max,
     ``l`` (hq, nq) running denominator — all float32.
+
+    Rematerialised (``jax.checkpoint``): reverse-mode would otherwise
+    store every block's softmax weights — O(seq²) residuals across the
+    scan/ring — where recomputing them in the backward pass keeps
+    training-style gradients O(chunk x seq) like the forward (the flash
+    attention backward trick). Measured: a causal 16k-token backward on
+    one chip OOMs HBM without this and runs with it.
     """
     d = q32.shape[-1]
     s = jnp.einsum(
@@ -291,9 +299,13 @@ def _check_seq(n: int, p: int, what: str) -> None:
         )
 
 
-def _check_gqa(q, k, what: str) -> int:
+def _check_gqa(q, k, v, what: str) -> int:
     """Validate GQA/MQA head counts; returns the group count hq // hkv."""
     hq, hkv = q.shape[0], k.shape[0]
+    if v.shape[0] != hkv:
+        raise ValueError(
+            f"{what}: v has {v.shape[0]} kv heads but k has {hkv}"
+        )
     if hq % hkv:
         raise ValueError(
             f"{what}: {hq} query heads not a multiple of {hkv} kv heads"
@@ -302,10 +314,11 @@ def _check_gqa(q, k, what: str) -> int:
 
 
 def _repeat_heads(k, v, groups: int):
-    """Broadcast K/V heads across query-head groups — always LOCAL (in
-    VMEM, never on the wire): the ring carries un-expanded K/V around and
-    expands per fold; Ulysses all-to-alls un-expanded K/V when the head
-    count allows."""
+    """Broadcast K/V heads across query-head groups. The ring keeps this
+    entirely LOCAL (un-expanded K/V ride the ppermutes, expansion happens
+    per fold in VMEM); Ulysses keeps it local whenever the head count
+    splits over the mesh, expanding pre-wire only as a last resort (and
+    then minimally — see ulysses_attention)."""
     if groups == 1:
         return k, v
     return jnp.repeat(k, groups, axis=0), jnp.repeat(v, groups, axis=0)
@@ -345,7 +358,7 @@ def ring_attention(
     if mesh is None:
         mesh = mesh_lib.make_mesh_1d(axis=axis)
     _check_seq(q.shape[1], mesh.shape[axis], "ring_attention")
-    _check_gqa(q, k, "ring_attention")
+    _check_gqa(q, k, v, "ring_attention")
     sharding = NamedSharding(mesh, _seq_spec(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return _sharded_attention_jit(q, k, v, local_fn=_ring_attention_local,
@@ -393,16 +406,21 @@ def ulysses_attention(
         mesh = mesh_lib.make_mesh_1d(axis=axis)
     p = mesh.shape[axis]
     _check_seq(q.shape[1], p, "ulysses_attention")
-    groups = _check_gqa(q, k, "ulysses_attention")
+    groups = _check_gqa(q, k, v, "ulysses_attention")
     if q.shape[0] % p:
         raise ValueError(
             f"ulysses_attention: {q.shape[0]} heads not divisible by mesh "
             f"size {p}; use ring_attention (no head constraint) instead"
         )
-    if k.shape[0] % p:
-        # Too few kv heads to split across the mesh — expand before the
-        # all_to_all (the hkv % p == 0 case rides the wire un-expanded).
-        k, v = _repeat_heads(k, v, groups)
+    hkv = k.shape[0]
+    if hkv % p:
+        # Too few kv heads to split across the mesh: expand pre-wire, but
+        # only to the smallest count divisible by p that still divides hq
+        # (the local repeat after the all_to_all covers the rest) — full
+        # expansion only as a last resort.
+        e = hkv * (p // math.gcd(hkv, p))
+        factor = e // hkv if q.shape[0] % e == 0 else groups
+        k, v = _repeat_heads(k, v, factor)
     sharding = NamedSharding(mesh, _seq_spec(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return _sharded_attention_jit(q, k, v, local_fn=_ulysses_local,
