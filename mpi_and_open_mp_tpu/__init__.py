@@ -14,6 +14,11 @@ coursework repo ``kekoveca/MPI-and-Open-MP``:
   collectives (reference: ``2-network-params/mpi_send_recv.c``).
 * The reference's measurement harness contracts: ``.cfg`` inputs,
   elapsed-seconds stdout, VTK snapshots, ``times.txt`` accumulation.
+* Beyond the reference: a first-class long-context sequence-parallel
+  attention layer (ring + Ulysses, GQA, rematerialised backward —
+  ``parallel.context``), bit-packed temporal-blocking Life kernels
+  (one collective round per 128 steps — ``ops.bitlife``), Orbax
+  checkpoint/resume, and a multi-host ``jax.distributed`` runtime.
 
 Subpackages
 -----------

@@ -26,6 +26,11 @@ def main(argv=None) -> int:
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--causal", action="store_true")
+    p.add_argument("--grad", action="store_true",
+                   help="time the backward pass too (rematerialised "
+                   "block updates keep it O(chunk x seq) memory)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA/MQA: fewer K/V heads than query heads")
     p.add_argument("--devices", type=int, default=None,
                    help="sp ring size (default: all local devices)")
     p.add_argument("--dtype", choices=("float32", "bfloat16"),
@@ -47,16 +52,31 @@ def main(argv=None) -> int:
           else context.ulysses_attention)
     dtype = jnp.dtype(args.dtype)
     rng = np.random.default_rng(args.seed)
-    shape = (args.heads, args.seq, args.head_dim)
-    q, k, v = (jnp.asarray(rng.standard_normal(shape), dtype)
-               for _ in range(3))
+    hkv = args.kv_heads or args.heads
+    q = jnp.asarray(
+        rng.standard_normal((args.heads, args.seq, args.head_dim)), dtype)
+    k, v = (jnp.asarray(
+        rng.standard_normal((hkv, args.seq, args.head_dim)), dtype)
+        for _ in range(2))
 
-    out = fn(q, k, v, mesh=mesh, causal=args.causal)  # compile + warm
-    np.asarray(jax.device_get(out[:1, :1, :1]))
+    if args.grad:
+        def loss(q, k, v):
+            o = fn(q, k, v, mesh=mesh, causal=args.causal)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        run = jax.grad(loss, argnums=(0, 1, 2))
+        sync = lambda r: np.asarray(jax.device_get(r[0][:1, :1, :1]))  # noqa: E731
+    else:
+        run = functools.partial(fn, mesh=mesh, causal=args.causal)
+        sync = lambda r: np.asarray(jax.device_get(r[:1, :1, :1]))  # noqa: E731
+
+    sync(run(q, k, v))  # compile + warm
     t0 = time.perf_counter()
-    out = fn(q, k, v, mesh=mesh, causal=args.causal)
-    np.asarray(jax.device_get(out[:1, :1, :1]))
+    result = run(q, k, v)
+    sync(result)
     elapsed = time.perf_counter() - t0
+    out = (fn(q, k, v, mesh=mesh, causal=args.causal) if args.grad
+           else result)
 
     if not args.no_check:
         want = context.attention_reference(
