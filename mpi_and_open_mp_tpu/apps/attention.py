@@ -11,6 +11,7 @@ elapsed seconds on stdout — the framework's standard timing contract
 from __future__ import annotations
 
 import argparse
+import functools
 import sys
 import time
 
@@ -59,16 +60,21 @@ def main(argv=None) -> int:
         rng.standard_normal((hkv, args.seq, args.head_dim)), dtype)
         for _ in range(2))
 
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
     if args.grad:
         def loss(q, k, v):
             o = fn(q, k, v, mesh=mesh, causal=args.causal)
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
         run = jax.grad(loss, argnums=(0, 1, 2))
-        sync = lambda r: np.asarray(jax.device_get(r[0][:1, :1, :1]))  # noqa: E731
     else:
         run = functools.partial(fn, mesh=mesh, causal=args.causal)
-        sync = lambda r: np.asarray(jax.device_get(r[:1, :1, :1]))  # noqa: E731
+    # All outputs (all three grads in --grad mode) must land before the
+    # timer stops. fetch_all: jax.grad outputs come back SingleDeviceSharding
+    # even on a mesh, and this is a timing bracket — one batched probe RTT
+    # buys a guaranteed landing on the tunneled-TPU stack.
+    sync = functools.partial(anchor_sync, fetch_all=True)
 
     sync(run(q, k, v))  # compile + warm
     t0 = time.perf_counter()

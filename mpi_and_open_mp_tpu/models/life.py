@@ -403,9 +403,9 @@ class LifeSim:
         fetch — blocking works for them and the fetch would cost a full
         host round trip inside the timing bracket.
         """
-        jax.block_until_ready(self.board)
-        if self.sharding is not None:
-            np.asarray(jax.device_get(self.board[:1, :1]))
+        from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+        anchor_sync(self.board)
 
     def reset(self) -> None:
         """Restore the initial board without rebuilding compiled steppers."""

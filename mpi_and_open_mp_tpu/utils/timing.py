@@ -12,6 +12,41 @@ from __future__ import annotations
 import time
 
 
+def anchor_sync(tree, fetch_all: bool = False) -> None:
+    """Wait until every array in ``tree`` has actually materialised.
+
+    ``jax.block_until_ready`` has been observed returning early for
+    mesh-placed arrays on tunneled-TPU stacks (step-count-independent
+    timings are the tell), so after blocking this anchors each mesh-placed
+    leaf with a one-element host fetch — from a locally addressable shard,
+    so it also works on multi-host arrays — batched into a single
+    ``device_get`` (one host RTT, not one per leaf). Single-device leaves
+    stay block-only by default: blocking does work for them on the stacks
+    observed, and the fetch would add a full host round trip inside timing
+    brackets. Pass ``fetch_all=True`` to probe those too, for brackets
+    where a guaranteed landing is worth one RTT.
+    """
+    import jax
+
+    jax.block_until_ready(tree)
+    probes = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if getattr(leaf, "sharding", None) is None or not hasattr(
+            leaf, "addressable_shards"
+        ):
+            continue
+        if not fetch_all and isinstance(
+            leaf.sharding, jax.sharding.SingleDeviceSharding
+        ):
+            continue
+        shard = leaf.addressable_shards[0].data
+        if shard.size == 0:
+            continue
+        probes.append(shard[(slice(0, 1),) * shard.ndim])
+    if probes:
+        jax.device_get(probes)
+
+
 class Timer:
     """Context manager measuring wall seconds; ``.elapsed`` after exit."""
 
