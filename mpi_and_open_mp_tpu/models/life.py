@@ -24,20 +24,23 @@ Instead of per-rank slabs with in-place ghost writes, the global board is ONE
   kernel; single-device meshes use the whole-board-in-VMEM multi-step
   kernel (see ``ops.pallas_life``).
 * ``impl="bitfused"`` (row/col/cart): the scale-out flagship — each
-  shard holds a bit-packed slab (``ops.bitlife``), exchanges a
-  4-word (=128-cell-row) y halo and/or a 128-column x halo by
-  ``ppermute`` (unsharded axes wrap locally; cart corners ride the
+  shard holds a bit-packed slab (``ops.bitlife``), exchanges an
+  up-to-4-word (=128-cell-row) y halo and/or an up-to-128-column x halo
+  by ``ppermute`` (unsharded axes wrap locally; cart corners ride the
   sequenced exchange), then runs up to 128 fused steps slab-resident
-  through the fused tiled kernel before the next exchange. One
-  collective round per 128 steps instead of per step; the ICI analogue
-  of the reference's ghost Send/Recv (``3-life/life_mpi.c:198-209``,
-  ``4-life:197-208``) amortised 128-fold.
+  through the fused kernel before the next exchange. One collective
+  round per up to 128 steps instead of per step; the ICI analogue of
+  the reference's ghost Send/Recv (``3-life/life_mpi.c:198-209``,
+  ``4-life:197-208``) amortised up to 128-fold. Any board shape on any
+  mesh the planner (``bitlife.plan_sharded_bits``) accepts — unaligned
+  boards (the 500x500 flagship included) live in a word/lane-aligned
+  padded frame whose torus wrap is kept exact via periodic mirrors and
+  funnel-shifted wrap halos.
 
 ``impl="auto"``: serial boards pick ``pallas`` on TPU / ``roll``
-elsewhere; sharded layouts pick ``bitfused`` on TPU when its alignment
-gates pass (``bitlife.fused_row_sharded_supported`` for the row ring,
-``fused_cart_sharded_supported`` for col/cart), else ``halo`` when
-shapes divide, else ``roll``.
+elsewhere; sharded layouts pick ``bitfused`` on TPU whenever the
+planner covers the board/mesh geometry, else ``halo`` when shapes
+divide, else ``roll``.
 
 The run loop preserves the reference's ordering (``3-life/life_mpi.c:51-62``):
 at step ``i``, save a snapshot when ``i % save_steps == 0`` (i.e. *before*
@@ -109,16 +112,19 @@ def _ceil_to(n: int, m: int) -> int:
 class LifeSim:
     """One Life run: sharded board state + compiled steppers + snapshot IO."""
 
-    def _bitfused_supported(self, layout: str, shape: tuple[int, int]) -> bool:
+    def _bitfused_plan(self, layout: str, shape: tuple[int, int]):
+        """The packed-path plan for this board/mesh, or None (serial
+        layouts, or geometry the frame-padding scheme can't cover)."""
         from mpi_and_open_mp_tpu.ops import bitlife
 
         if layout == "serial":
-            return False
+            return None
         py, px = _mesh_divisors(layout, self.mesh)
-        if layout == "row":
-            return bitlife.fused_row_sharded_supported(shape, py)
-        # col is the py=1 cart case (y wrap is shard-local).
-        return bitlife.fused_cart_sharded_supported(shape, py, px)
+        return bitlife.plan_sharded_bits(
+            shape, py, px,
+            y_sharded=layout in ("row", "cart"),
+            x_sharded=layout in ("col", "cart"),
+        )
 
     def __init__(
         self,
@@ -149,14 +155,20 @@ class LifeSim:
         self.step_count = int(initial_step)
 
         divisible = _divisible(cfg.shape, layout, self.mesh)
+        plan = (
+            self._bitfused_plan(layout, cfg.shape)
+            if impl in ("auto", "bitfused")
+            else None
+        )
         if impl == "auto":
             on_tpu = jax.default_backend() == "tpu"
             if layout == "serial":
                 # Pallas only where it compiles natively; elsewhere it would
                 # run in interpret mode, orders of magnitude slower.
                 impl = "pallas" if on_tpu else "roll"
-            elif on_tpu and self._bitfused_supported(layout, cfg.shape):
-                # Best sharded path when its alignment gates pass: one
+            elif on_tpu and plan is not None:
+                # Best sharded path whenever the frame-padding plan covers
+                # the geometry (any board shape, aligned or not): one
                 # collective round per <=128 fused steps. TPU-only — on
                 # CPU the kernel would run in interpret mode.
                 impl = "bitfused"
@@ -181,14 +193,15 @@ class LifeSim:
                     "serial big boards already take the fused kernel via "
                     "impl='pallas'"
                 )
-            if not self._bitfused_supported(layout, cfg.shape):
+            if plan is None:
                 raise ValueError(
-                    f"impl='bitfused' needs board {cfg.shape} with "
-                    f"32*mesh_y-aligned rows, 128-aligned shard columns "
-                    f"(mesh {dict(self.mesh.shape)}), and a legal tile "
-                    "split per shard; use impl='halo' or 'roll'"
+                    f"impl='bitfused' can't plan board {cfg.shape} over "
+                    f"mesh {dict(self.mesh.shape)}: a shard is too small "
+                    "to carry a fused halo next to its frame padding; use "
+                    "impl='halo' or 'roll'"
                 )
         self.impl = impl
+        self._plan = plan if impl == "bitfused" else None
 
         if impl in ("halo", "pallas") and layout != "serial":
             py, px = _mesh_divisors(layout, self.mesh)
@@ -207,9 +220,14 @@ class LifeSim:
         )
         # Uneven boards: store padded to the next mesh-even multiple; the
         # roll step un/re-pads inside jit so the torus wrap stays on the
-        # LOGICAL (ny, nx) coordinates, never the padded ones.
-        py, px = _mesh_divisors(layout, self.mesh)
-        self.padded_shape = (_ceil_to(cfg.ny, py), _ceil_to(cfg.nx, px))
+        # LOGICAL (ny, nx) coordinates, never the padded ones. The packed
+        # path pads further, to its word/lane-aligned frame, and keeps the
+        # torus via periodic mirrors (ops.bitlife module docs).
+        if self._plan is not None:
+            self.padded_shape = self._plan.frame
+        else:
+            py, px = _mesh_divisors(layout, self.mesh)
+            self.padded_shape = (_ceil_to(cfg.ny, py), _ceil_to(cfg.nx, px))
         if initial_board is not None:
             board = np.asarray(initial_board, dtype=np.uint8)
             if board.shape != cfg.shape:
@@ -323,28 +341,28 @@ class LifeSim:
 
         Each shard packs its slab once per ``advance`` call (pack/unpack are
         fused XLA ops, amortised over the whole step budget), then loops:
-        exchange ``_FUSE_HALO_WORDS`` word rows (row layout; plus
-        ``_FUSE_HALO_X`` columns first on the cart mesh — corners ride the
-        y-exchange of the x-extended slab, the reference's 2-phase trick at
-        ``6-cartesian/life_cart.c:275-279``), run ``min(rem,
-        FUSE_MAX_STEPS)`` steps slab-resident via the fused tiled kernel,
-        repeat. ``n`` is a runtime scalar — one compiled program serves
-        every segment length.
+        exchange the plan's halo word rows (row layout; plus halo columns
+        first on col/cart meshes — corners ride the y-exchange of the
+        x-extended slab, the reference's 2-phase trick at
+        ``6-cartesian/life_cart.c:275-279``), run ``min(rem, k_max)``
+        steps slab-resident via the fused kernel, repeat. Unaligned
+        boards live in the plan's padded frame: the halo calls slide the
+        torus wrap onto the logical shape and refresh the periodic
+        mirrors (``halo.packed_halo_*``/``bitlife.wrap_y_padded``), so
+        the same one-collective-per-k_max-steps economy covers every
+        shape — the reference's per-step ghost Send/Recv
+        (``3-life/life_mpi.c:198-209``) amortised up to 128-fold. ``n``
+        is a runtime scalar — one compiled program serves every segment
+        length.
         """
         from mpi_and_open_mp_tpu.ops import bitlife
 
+        plan = self._plan
         mesh = self.mesh
         spec = _layout_spec(self.layout)
-        ny, nx = self.cfg.shape
-        py, px = _mesh_divisors(self.layout, mesh)
-        h = bitlife._FUSE_HALO_WORDS
+        ny = self.cfg.ny
         interpret = jax.default_backend() != "tpu"
-        x_sharded = self.layout in ("col", "cart")
-        y_sharded = self.layout in ("row", "cart")
-        step_call = bitlife.make_fused_stepper(
-            ny // 32 // py, nx // px, interpret=interpret,
-            halo_x=bitlife._FUSE_HALO_X if x_sharded else 0,
-        )
+        step_call = bitlife.make_plan_stepper(plan, interpret=interpret)
         dtype = self.dtype
 
         def shard_fn(block, n):
@@ -352,17 +370,23 @@ class LifeSim:
 
             def body(carry):
                 q, rem = carry
-                k = jnp.minimum(rem, bitlife.FUSE_MAX_STEPS)
-                # The packed, 32x-amortised ghost exchange: the same ring
-                # halos as every other impl, in word rows / lane columns
-                # (cf. 3-life/life_mpi.c:203-207, 4-life:197-208). Axes
-                # the mesh doesn't shard wrap locally — same content, no
-                # collective.
-                extx = (halo.halo_pad_x(q, "x", bitlife._FUSE_HALO_X)
-                        if x_sharded else q)
-                ext = (halo.halo_pad_y(extx, "y", h) if y_sharded
-                       else bitlife.wrap_y(extx, h))
-                return step_call(k.reshape(1), ext), rem - k
+                k = jnp.minimum(rem, plan.k_max)
+                # The packed, k_max-amortised ghost exchange: the same
+                # ring halos as every other impl, in word rows / lane
+                # columns (cf. 3-life/life_mpi.c:203-207, 4-life:197-208).
+                # Axes the mesh doesn't shard wrap locally — same content,
+                # no collective; unsharded unaligned x needs nothing at
+                # all (the kernel's wrap-patched rolls are exact).
+                e = q
+                if plan.x_sharded:
+                    e = halo.packed_halo_x(e, "x", plan.hx, pad=plan.pad_x)
+                if plan.y_sharded:
+                    e = halo.packed_halo_y(e, "y", plan.h, pad=plan.pad_y)
+                elif plan.pad_y:
+                    e = bitlife.wrap_y_padded(e, ny, plan.h)
+                else:
+                    e = bitlife.wrap_y(e, plan.h)
+                return step_call(k.reshape(1), e), rem - k
 
             q, _ = lax.while_loop(
                 lambda c: c[1] > 0, body, (packed, jnp.int32(n))

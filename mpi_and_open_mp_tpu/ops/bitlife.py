@@ -34,6 +34,7 @@ the compiled-XLA packed loop (:func:`life_run_bits_xla`).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import numpy as np
@@ -161,32 +162,22 @@ def _carry_save_rule(c, up, dn, roll_left, roll_right) -> jnp.ndarray:
     return is3 | (c & is4)
 
 
-def bit_step(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
-    """One Life step on a packed board (ghost refresh + bitwise rule).
-
-    ``p`` may be lane-padded (``p.shape[1] > nx``): Mosaic lane rolls at
-    a non-128-multiple width cost ~3.4x (measured 401 vs 1376 Gcups at
-    500² vs 512² on v5e), so the runner pads the board to the next lane
-    multiple and the two wrap columns are patched explicitly — slack
-    columns carry junk that never feeds a valid column.
-    """
-    p = _refresh_ghosts(p, ny)
-    nw, nxp = p.shape
-    # y-neighbours: single-bit shifts through the packed words. The junk
-    # carried into ghost/slack positions never reaches a live bit.
-    dn = (p << 1) | (_roll_sub(p, 1) >> 31)
-    up = (p >> 1) | (_roll_sub(p, nw - 1) << 31)
+def _lane_rolls(shape: tuple[int, int], nx: int):
+    """``(roll_left, roll_right)`` lane-neighbour rolls with the torus
+    wrap at column ``nx``. When the array is wider than ``nx`` (lane
+    padding) the two wrap columns are patched explicitly: lane 0's true
+    left neighbour is column ``nx-1`` (the roll would hand it a slack
+    column), and lane ``nx-1``'s right neighbour is column 0 — slack
+    columns carry junk that never feeds a valid column."""
+    nxp = shape[1]
     if nxp == nx:
-        return _carry_save_rule(
-            p, up, dn,
+        return (
             lambda x: pltpu.roll(x, 1, 1),
             lambda x: pltpu.roll(x, nx - 1, 1),
         )
-    lane = lax.broadcasted_iota(jnp.int32, (nw, nxp), 1)
+    lane = lax.broadcasted_iota(jnp.int32, shape, 1)
 
     def roll_left(x):
-        # Lane i takes x[i-1]; lane 0's true left neighbour is column
-        # nx-1 (the roll would hand it slack column nxp-1).
         return jnp.where(lane == 0, x[:, nx - 1 : nx], pltpu.roll(x, 1, 1))
 
     def roll_right(x):
@@ -194,7 +185,24 @@ def bit_step(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
             lane == nx - 1, x[:, 0:1], pltpu.roll(x, nxp - 1, 1)
         )
 
-    return _carry_save_rule(p, up, dn, roll_left, roll_right)
+    return roll_left, roll_right
+
+
+def bit_step(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
+    """One Life step on a packed board (ghost refresh + bitwise rule).
+
+    ``p`` may be lane-padded (``p.shape[1] > nx``): Mosaic lane rolls at
+    a non-128-multiple width cost ~3.4x (measured 401 vs 1376 Gcups at
+    500² vs 512² on v5e), so the runner pads the board to the next lane
+    multiple and the wrap columns are patched (see :func:`_lane_rolls`).
+    """
+    p = _refresh_ghosts(p, ny)
+    nw = p.shape[0]
+    # y-neighbours: single-bit shifts through the packed words. The junk
+    # carried into ghost/slack positions never reaches a live bit.
+    dn = (p << 1) | (_roll_sub(p, 1) >> 31)
+    up = (p >> 1) | (_roll_sub(p, nw - 1) << 31)
+    return _carry_save_rule(p, up, dn, *_lane_rolls(p.shape, nx))
 
 
 def _vmem_bits_kernel(steps_ref, p_ref, out_ref, *, ny: int, nx: int):
@@ -275,22 +283,28 @@ _FUSE_HALO_WORDS = 4
 FUSE_MAX_STEPS = 32 * _FUSE_HALO_WORDS
 
 
-def _fused_window_step(w: jnp.ndarray, nx: int) -> jnp.ndarray:
+def _fused_window_step(
+    w: jnp.ndarray, nx: int, nx_exact: int | None = None
+) -> jnp.ndarray:
     """One Life step over a full tile window (no ghost refresh: y-wrap
     content is real halo rows; the sublane-roll junk entering the two
-    outermost bit rows is tracked by the validity argument above)."""
+    outermost bit rows is tracked by the validity argument above).
+
+    ``nx_exact`` set (and < ``nx``) means the window is a lane-padded
+    board whose torus wrap must land on the logical column: the lane
+    rolls get the same wrap-column patch as :func:`bit_step`, the pad
+    columns carry junk that never feeds a valid column, and no x halo
+    or validity tracking is needed in the lane dimension at all.
+    """
     dn = (w << 1) | (_roll_sub(w, 1) >> 31)
     up = (w >> 1) | (_roll_sub(w, w.shape[0] - 1) << 31)
-    return _carry_save_rule(
-        w, up, dn,
-        lambda x: pltpu.roll(x, 1, 1),
-        lambda x: pltpu.roll(x, nx - 1, 1),
-    )
+    wrap = nx if nx_exact is None else nx_exact
+    return _carry_save_rule(w, up, dn, *_lane_rolls(w.shape, wrap))
 
 
 def _fused_tiles_kernel(
     k_ref, hbm_ref, out_ref, scratch, sem, *, tr: int, hx: int = 0,
-    cx: int | None = None,
+    cx: int | None = None, nx_exact: int | None = None,
 ):
     """One program = one (tr, cx-or-full-width) output tile, ``k_ref[0]``
     fused steps.
@@ -320,7 +334,8 @@ def _fused_tiles_kernel(
     cp.start()
     cp.wait()
     w = lax.fori_loop(
-        0, k_ref[0], lambda _, x: _fused_window_step(x, w_ext), scratch[:]
+        0, k_ref[0],
+        lambda _, x: _fused_window_step(x, w_ext, nx_exact), scratch[:]
     )
     out_ref[:] = w[h : h + tr, hx : w_ext - hx]
 
@@ -353,37 +368,23 @@ def fused_bits_supported(shape: tuple[int, int]) -> bool:
     return _fused_tile_words(nw, nx) >= 8 or _col_tile_plan(nw, nx) is not None
 
 
-def fused_row_sharded_supported(shape: tuple[int, int], p: int) -> bool:
-    """Same gates for the row-sharded multi-chip path: each of ``p`` ring
-    shards must hold a word-aligned slab with a legal tile split."""
-    ny, nx = shape
-    return (
-        ny % (32 * p) == 0
-        and nx % 128 == 0
-        and _fused_tile_words(ny // 32 // p, nx) >= 8
-    )
-
-
 # Column halo for the 2-D (cart) fused path: 128 lanes = 128 cell columns
 # per side, matching FUSE_MAX_STEPS (x junk marches 1 column per step).
 _FUSE_HALO_X = 128
 
 
+def fused_row_sharded_supported(shape: tuple[int, int], p: int) -> bool:
+    """Whether the row-sharded bitfused path runs ``shape`` over a
+    ``p``-way ring — any board the frame-padding plan accepts (see
+    :func:`plan_sharded_bits`), alignment no longer required."""
+    return plan_sharded_bits(shape, p, 1, True, False) is not None
+
+
 def fused_cart_sharded_supported(
     shape: tuple[int, int], py: int, px: int
 ) -> bool:
-    """Gates for the 2-D cart bitfused path: word-aligned y slabs,
-    128-aligned x slabs (also ensures the halo slice fits the shard), and
-    a legal tile split at the halo-extended width. The column-strip
-    layout is the ``py=1`` case (y wrap becomes a local concat)."""
-    ny, nx = shape
-    if ny % (32 * py) or nx % px:
-        return False
-    nxl = nx // px
-    return (
-        nxl % 128 == 0
-        and _col_tile_plan(ny // 32 // py, nxl) is not None
-    )
+    """Same for the 2-D cart bitfused path (``py=1``: column strips)."""
+    return plan_sharded_bits(shape, py, px, True, True) is not None
 
 
 def _col_tile_plan(
@@ -415,6 +416,7 @@ def make_fused_stepper(
     interpret: bool,
     tile_budget_bytes: int = _PACKED_VMEM_LIMIT,
     halo_x: int = 0,
+    nx_exact: int | None = None,
 ):
     """Build ``step_call(k, ext) -> (nw, nxl)``: the fused tiled kernel
     over a wrap-extended ``(nw + 2*_FUSE_HALO_WORDS, nxl + 2*halo_x)``
@@ -427,6 +429,7 @@ def make_fused_stepper(
     h = _FUSE_HALO_WORDS
     w_ext = nxl + 2 * halo_x
     if halo_x:
+        assert nx_exact is None, "wrap-patched rolls need the full width"
         plan = _col_tile_plan(nw, nxl, tile_budget_bytes)
         if plan is None:
             raise ValueError(
@@ -449,7 +452,8 @@ def make_fused_stepper(
                 "gate callers on fused_bits_supported()"
             )
         grid = (nw // tr,)
-        kernel = functools.partial(_fused_tiles_kernel, tr=tr)
+        kernel = functools.partial(
+            _fused_tiles_kernel, tr=tr, nx_exact=nx_exact)
         out_block = pl.BlockSpec(
             (tr, nxl), lambda i: (i, 0), memory_space=pltpu.VMEM)
         scratch_w = nxl
@@ -476,6 +480,236 @@ def wrap_y(p: jnp.ndarray, h: int = _FUSE_HALO_WORDS) -> jnp.ndarray:
     y halo. Sharded axes get the same rows via ``ppermute`` instead
     (``halo.halo_pad_y``); both must honour ``_FUSE_HALO_WORDS``."""
     return jnp.concatenate([p[-h:], p, p[:h]], axis=0)
+
+
+# ------------------------------------- arbitrary shapes (padded torus frame)
+#
+# The fused kernels above want word-aligned rows and lane-aligned columns.
+# To run ANY board (the reference's flagship is 500x500 —
+# ``3-life/p46gun_big.cfg:3``) on any mesh, the board is stored in a FRAME
+# padded up to (32*py)-row / lane-pitch-column alignment, kept consistent
+# with the infinite periodic tiling of the logical board:
+#
+# * frame rows   [ny, Nyp)  mirror board rows    [0, pad_y)
+# * frame cols   [nx, Nxp)  mirror board columns [0, pad_x)  (sharded x)
+#
+# A window whose content agrees with the periodic tiling evolves every
+# cell — mirrors included — exactly as the torus does, so the mirrors
+# self-maintain across fused rounds; they are still refreshed from the
+# authoritative shard each round (cheap, and fixes the zero-padded initial
+# state). The wrap halos are then *unaligned* row/column ranges of the
+# frame, extracted with funnel shifts (:func:`take_rows`) outside the
+# kernel — the kernel itself never learns the board was unaligned. For
+# unsharded x the mirror machinery is unnecessary: the wrap-column-patched
+# rolls of :func:`bit_step` (``nx_exact``) give an exact x torus at any
+# width.
+
+
+def take_rows(words: jnp.ndarray, start: int, h: int) -> jnp.ndarray:
+    """Bit rows ``[start, start + 32*h)`` of a packed word stack.
+
+    ``start`` is a static bit-row offset. Word-aligned offsets are plain
+    slices; anything else funnels each output word from two neighbouring
+    input words — the packed-layout form of an unaligned row slice.
+    """
+    q, b = divmod(start, 32)
+    if b == 0:
+        return words[q : q + h]
+    return (words[q : q + h] >> b) | (words[q + 1 : q + h + 1] << (32 - b))
+
+
+def mirror_tail(e: jnp.ndarray, src: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Rewrite the last ``pad`` bit rows of frame shard ``e`` with rows
+    ``[0, pad)`` of ``src`` — the periodic-mirror refresh: frame rows
+    ``[ny, Nyp)`` must copy board rows ``[0, pad_y)`` so every window cut
+    from the frame agrees with the torus tiling. ``src`` must carry at
+    least ``pad + 32`` bit rows starting at board row 0."""
+    nw = e.shape[0]
+    q, b = divmod(pad, 32)
+    parts = [e[: nw - q - (1 if b else 0)]]
+    if b:
+        keep = np.uint32((1 << (32 - b)) - 1)
+        parts.append(((e[nw - 1 - q] & keep) | (src[0] << (32 - b)))[None])
+    if q:
+        parts.append(take_rows(src, b, q))
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+
+def wrap_y_padded(e: jnp.ndarray, ny: int, h: int) -> jnp.ndarray:
+    """Local y-extension of a packed frame taller than the board: refresh
+    the mirror rows, then append funnel-shifted torus borders — the
+    unaligned generalisation of :func:`wrap_y` (its exact degenerate).
+    Callers must honour the :func:`plan_sharded_bits` gate
+    ``h + 1 + pad//32 <= nw``."""
+    nw = e.shape[0]
+    pad = 32 * nw - ny
+    if pad == 0:
+        return wrap_y(e, h)
+    s = h + 1 + pad // 32
+    # Top border = board rows [ny - 32h, ny): real rows only — the funnel
+    # stops one bit short of the mirror region (checked in tests).
+    top = take_rows(e[-s:], 32 * s - pad - 32 * h, h)
+    bot = take_rows(e[:s], pad, h)
+    e = mirror_tail(e, e[:s], pad)
+    return jnp.concatenate([top, e, bot], axis=0)
+
+
+def make_window_stepper(
+    nw: int,
+    nxl: int,
+    *,
+    h: int,
+    halo_x: int = 0,
+    nx_exact: int | None = None,
+    interpret: bool = False,
+):
+    """Whole-shard fused stepper: the halo-extended window VMEM-resident
+    in a single program, ``k_ref[0]`` fused steps, interior write-back.
+
+    The small-shard counterpart of :func:`make_fused_stepper` (whose DMA
+    tiles need >=8 word rows): a 500x500 board over an 8-way ring packs
+    to 2-word slabs, far below any legal tile split, but the whole
+    halo-extended window is then a few KB — exactly the VMEM-resident
+    regime. Same calling convention as the tiled stepper.
+    """
+    w_ext = nxl + 2 * halo_x
+
+    def kernel(k_ref, ext_ref, out_ref):
+        w = lax.fori_loop(
+            0, k_ref[0],
+            lambda _, x: _fused_window_step(x, w_ext, nx_exact),
+            ext_ref[:],
+        )
+        out_ref[:] = w[h : h + nw, halo_x : halo_x + nxl]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((nw, nxl), jnp.uint32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BitPlan:
+    """How to run one board/mesh combination through the packed fused
+    path: frame padding, halo depths, fuse budget, and stepper kind.
+    Produced by :func:`plan_sharded_bits`; consumed by
+    :func:`make_plan_stepper` and the model layer's exchange loop."""
+
+    shape: tuple[int, int]   # logical (ny, nx)
+    py: int
+    px: int
+    y_sharded: bool
+    x_sharded: bool
+    frame: tuple[int, int]   # padded (Nyp, Nxp) — the stored board shape
+    pad_y: int
+    pad_x: int
+    nw_s: int                # packed word rows per shard
+    W: int                   # columns per shard
+    h: int                   # y halo words per side
+    hx: int                  # x halo columns per side (0 = no x border)
+    nx_exact: int | None     # wrap-patched roll width (unsharded pad_x>0)
+    k_max: int               # fused steps per exchange round
+    mode: str                # "window" | "tiled"
+    budget: int              # VMEM budget the mode choice was validated at
+
+
+def plan_sharded_bits(
+    shape: tuple[int, int],
+    py: int,
+    px: int,
+    y_sharded: bool,
+    x_sharded: bool,
+    budget: int = _PACKED_VMEM_LIMIT,
+) -> BitPlan | None:
+    """Plan the packed fused path for ANY board over a ``(py, px)`` mesh.
+
+    Returns None only when the geometry is genuinely hopeless for halo
+    fusion (a shard too small to carry even a 1-word halo next to its
+    padding, or a window/tile split that fits no VMEM budget) — callers
+    then fall back to the unpacked halo/roll impls. Covers the
+    reference's per-step ghost exchange (``3-life/life_mpi.c:198-209``)
+    amortised ``k_max``-fold for every shape, not just aligned ones.
+    """
+    ny, nx = shape
+    if ny < 8 or nx < 8:
+        return None
+    # ---- x axis: lane pitch, pad, halo columns.
+    if x_sharded:
+        nx_exact = None
+        W = -(-nx // (128 * px)) * 128
+        pad_x = W * px - nx
+        hx = _FUSE_HALO_X
+        if W - pad_x < hx:
+            # Narrow shards can't feed a full 128-column halo: re-pitch at
+            # 8-column granularity (unaligned lane rolls cost ~3.4x but
+            # the fused path still wins) and shrink the halo — and with
+            # it k_max — to what a neighbour can supply.
+            W = -(-nx // (8 * px)) * 8
+            pad_x = W * px - nx
+            hx = min(_FUSE_HALO_X, W - pad_x)
+            if hx < 8:
+                return None
+    else:
+        W = -(-nx // 128) * 128
+        pad_x = W - nx
+        hx = 0
+        nx_exact = nx if pad_x else None
+    # ---- y axis: word pitch, pad, halo words.
+    nw_s = -(-ny // (32 * py))
+    pad_y = 32 * nw_s * py - ny
+    if pad_y:
+        # Wrap funnels read h+1+pad_y//32 words from the neighbour; the
+        # shard must hold them (and the wrap-border source rows).
+        h = min(_FUSE_HALO_WORDS, nw_s - 1 - pad_y // 32)
+    else:
+        h = min(_FUSE_HALO_WORDS, nw_s)
+    if h < 1:
+        return None
+    k_max = min(32 * h, hx or FUSE_MAX_STEPS, FUSE_MAX_STEPS)
+    # ---- stepper kind: whole-window VMEM program when it fits, else the
+    # DMA-tiled kernel (which needs full-depth halos and lane alignment).
+    if (nw_s + 2 * h) * (W + 2 * hx) * 4 <= budget:
+        mode = "window"
+    elif h == _FUSE_HALO_WORDS and W % 128 == 0:
+        if hx:
+            if hx != _FUSE_HALO_X or _col_tile_plan(nw_s, W, budget) is None:
+                return None
+        elif _fused_tile_words(nw_s, W, budget) < 8:
+            return None
+        mode = "tiled"
+    else:
+        return None
+    return BitPlan(
+        shape=shape, py=py, px=px,
+        y_sharded=y_sharded, x_sharded=x_sharded,
+        frame=(32 * nw_s * py, W * px), pad_y=pad_y, pad_x=pad_x,
+        nw_s=nw_s, W=W, h=h, hx=hx, nx_exact=nx_exact,
+        k_max=k_max, mode=mode, budget=budget,
+    )
+
+
+def make_plan_stepper(plan: BitPlan, *, interpret: bool = False):
+    """``step_call(k, ext) -> (nw_s, W)`` for a :class:`BitPlan`: the
+    whole-window VMEM program for small shards, the DMA-tiled kernel for
+    large ones (tiled at the same budget the planner validated the mode
+    choice against). ``ext`` is the ``(nw_s + 2h, W + 2hx)`` halo-extended
+    packed shard the model layer assembles each exchange round."""
+    if plan.mode == "window":
+        return make_window_stepper(
+            plan.nw_s, plan.W, h=plan.h, halo_x=plan.hx,
+            nx_exact=plan.nx_exact, interpret=interpret,
+        )
+    return make_fused_stepper(
+        plan.nw_s, plan.W, interpret=interpret,
+        tile_budget_bytes=plan.budget,
+        halo_x=plan.hx, nx_exact=plan.nx_exact,
+    )
 
 
 @functools.partial(

@@ -65,6 +65,72 @@ def halo_pad_x(block: jnp.ndarray, axis_name: str = "x", depth: int = 1) -> jnp.
     return jnp.concatenate([left, block, right], axis=1)
 
 
+def packed_halo_y(
+    e: jnp.ndarray, axis_name: str = "y", h: int = 4, *, pad: int = 0
+) -> jnp.ndarray:
+    """y halo of a bit-packed frame shard (word rows x cell columns).
+
+    ``h`` ghost words per side travel the ring; when the frame carries
+    ``pad`` mirror rows (board height padded to 32*py alignment — see
+    ``ops.bitlife.plan_sharded_bits``) the wrap edges are funnel-shifted
+    onto the LOGICAL board height and the wrap shard's mirror rows are
+    refreshed from the first shard's live data. ``pad == 0`` degenerates
+    to :func:`halo_pad_y`. With one shard on the axis this is the local
+    torus wrap, same content as ``bitlife.wrap_y_padded``.
+    """
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    if pad == 0:
+        return halo_pad_y(e, axis_name, h)
+    p = _axis_size(axis_name)
+    s = h + 1 + pad // 32
+    up = lax.ppermute(e[-s:], axis_name, ring_perm(p, 1))
+    dn = lax.ppermute(e[:s], axis_name, ring_perm(p, -1))
+    i = lax.axis_index(axis_name)
+    # Shard 0's top ghost is board rows [ny-32h, ny) — an unaligned range
+    # of the LAST shard (the frame's tail is mirror rows, not the wrap);
+    # interior shards take their predecessor's word-aligned tail.
+    top = jnp.where(
+        i == 0,
+        bitlife.take_rows(up, 32 * s - pad - 32 * h, h),
+        up[s - h :],
+    )
+    bot = jnp.where(
+        i == p - 1, bitlife.take_rows(dn, pad, h), dn[:h]
+    )
+    e = jnp.where(i == p - 1, bitlife.mirror_tail(e, dn, pad), e)
+    return jnp.concatenate([top, e, bot], axis=0)
+
+
+def packed_halo_x(
+    block: jnp.ndarray, axis_name: str = "x", hx: int = 128, *, pad: int = 0
+) -> jnp.ndarray:
+    """x halo of a packed frame shard, ``hx`` ghost columns per side.
+
+    Column-granular twin of :func:`packed_halo_y`: with ``pad`` mirror
+    columns (board width padded to the lane pitch) the wrap edges are
+    slid onto the logical board width and the wrap shard's mirror
+    columns are refreshed; ``pad == 0`` degenerates to
+    :func:`halo_pad_x`. Packed columns are whole cell columns, so unlike
+    y there is no bit-level funnel — just offset slices.
+    """
+    if pad == 0:
+        return halo_pad_x(block, axis_name, hx)
+    p = _axis_size(axis_name)
+    s = hx + pad
+    left = lax.ppermute(block[:, -s:], axis_name, ring_perm(p, 1))
+    right = lax.ppermute(block[:, :s], axis_name, ring_perm(p, -1))
+    i = lax.axis_index(axis_name)
+    lb = jnp.where(i == 0, left[:, :hx], left[:, pad:])
+    rb = jnp.where(i == p - 1, right[:, pad : pad + hx], right[:, :hx])
+    block = jnp.where(
+        i == p - 1,
+        jnp.concatenate([block[:, :-pad], right[:, :pad]], axis=1),
+        block,
+    )
+    return jnp.concatenate([lb, block, rb], axis=1)
+
+
 def halo_pad_2d(
     block: jnp.ndarray,
     axis_y: str = "y",

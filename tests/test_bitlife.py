@@ -178,3 +178,91 @@ def test_empty_board_stays_empty():
         bitlife.life_run_vmem_bits(jnp.asarray(b), 10, interpret=True)
     )
     assert got.sum() == 0
+
+
+# ------------------------------------------ padded-frame (unaligned) helpers
+
+
+def test_take_rows_funnel():
+    """take_rows must equal an unpack-slice-repack round trip at every
+    bit offset, aligned and not."""
+    b = _soup(96, 16, seed=11)
+    packed = bitlife.pack_board_exact(jnp.asarray(b))
+    for start, h in [(0, 1), (32, 2), (5, 1), (37, 1), (1, 2), (63, 1)]:
+        got = np.asarray(bitlife.take_rows(packed, start, h))
+        want = np.asarray(bitlife.pack_board_exact(
+            jnp.asarray(b[start : start + 32 * h])))
+        assert np.array_equal(got, want), (start, h)
+
+
+@pytest.mark.parametrize("pad", [1, 12, 31, 32, 45, 64])
+def test_mirror_tail(pad):
+    """The last ``pad`` bit rows become copies of rows [0, pad)."""
+    rows = 128
+    b = _soup(rows, 16, seed=3)
+    packed = bitlife.pack_board_exact(jnp.asarray(b))
+    src = bitlife.take_rows(packed, 0, 3)  # rows [0, 96) >= pad + 32
+    got = np.asarray(bitlife.unpack_board_exact(
+        bitlife.mirror_tail(packed, src, pad)))
+    want = b.copy()
+    want[rows - pad :] = b[:pad]
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("ny,h", [(100, 2), (97, 1), (128, 2), (70, 1)])
+def test_wrap_y_padded_matches_logical_torus(ny, h):
+    """The local padded wrap must present, in window coordinates, exactly
+    the periodic extension of the logical board: mirrors refreshed, top
+    border = rows [ny-32h, ny), bottom border = rows [pad, pad+32h)."""
+    nw = -(-ny // 32)
+    pad = 32 * nw - ny
+    b = _soup(ny, 24, seed=9)
+    frame = np.zeros((32 * nw, 24), np.uint8)
+    frame[:ny] = b
+    ext = np.asarray(bitlife.unpack_board_exact(bitlife.wrap_y_padded(
+        bitlife.pack_board_exact(jnp.asarray(frame)), ny, h)))
+    want = np.concatenate([
+        b[ny - 32 * h :],                            # top wrap border
+        b, b[:pad],                                  # frame, live mirrors
+        np.concatenate([b, b])[pad : pad + 32 * h],  # bottom border: the
+        # periodic extension continued past the frame = rows [pad, pad+32h)
+    ])
+    assert np.array_equal(ext, want)
+
+
+@pytest.mark.parametrize("steps", [1, 40, 130])
+def test_fused_stepper_tiled_unaligned_x(steps):
+    """The DMA-tiled kernel with wrap-patched lane rolls (unsharded
+    unaligned x): a 768x250 board in a 768x256 frame, tile budget forced
+    small enough that the window stepper is rejected and full-width row
+    tiles carry the fused rounds."""
+    ny, nx = 768, 250
+    budget = 20_000
+    plan = bitlife.plan_sharded_bits((ny, nx), 1, 1, False, False,
+                                     budget=budget)
+    assert plan is not None and plan.mode == "tiled"
+    assert plan.nx_exact == nx and plan.pad_y == 0
+    b = _soup(ny, nx, seed=21)
+    frame = np.zeros((ny, plan.W), np.uint8)
+    frame[:, :nx] = b
+    step = bitlife.make_plan_stepper(plan, interpret=True)
+    q = bitlife.pack_board_exact(jnp.asarray(frame))
+    rem = steps
+    while rem > 0:
+        k = min(rem, plan.k_max)
+        q = step(jnp.asarray([k], jnp.int32), bitlife.wrap_y(q, plan.h))
+        rem -= k
+    got = np.asarray(bitlife.unpack_board_exact(q))[:, :nx]
+    assert np.array_equal(got, _oracle(b, steps))
+
+
+def test_plan_window_small_shards():
+    """500x500 over an 8-way ring — the geometry every pre-plan gate
+    rejected (2-word shards) — must plan onto the window stepper."""
+    plan = bitlife.plan_sharded_bits((500, 500), 8, 1, True, False)
+    assert plan is not None and plan.mode == "window"
+    assert plan.frame == (512, 512) and plan.nw_s == 2 and plan.h == 1
+    assert plan.nx_exact == 500 and plan.k_max == 32
+    # Hopeless geometry still returns None.
+    assert bitlife.plan_sharded_bits((64, 128), 8, 1, True, False) is None
+    assert bitlife.plan_sharded_bits((256, 20), 4, 2, True, True) is None

@@ -111,23 +111,56 @@ def test_parity_bitfused_cart_mesh(make_board, steps):
     np.testing.assert_array_equal(sim.collect(), oracle_n(board, steps))
 
 
+@pytest.mark.parametrize(
+    "shape,layout,mesh_args,steps",
+    [
+        # The flagship geometry (3-life/p46gun_big.cfg): 500x500 on the
+        # 8-way ring — 512x512 frame, 2-word shards, window stepper,
+        # k_max=32 so 40 steps crosses a round boundary.
+        ((500, 500), "row", None, 40),
+        # 500x500 on the default 2-D mesh: funnel y wrap + mirror x wrap
+        # + corners, k_max=96.
+        ((500, 500), "cart", (4, 2), 100),
+        # Narrow column strips: 8-column re-pitch, shrunken x halo.
+        ((500, 500), "col", None, 60),
+        # Small unaligned boards, both axes padded (row on a 2-D mesh
+        # shards y only; a 2-way ring leaves room for the halo).
+        ((100, 130), "row", (2, 4), 40),
+        ((100, 300), "cart", (2, 2), 40),
+        # Previously gate-rejected aligned-ish shapes, now planned:
+        ((2040, 128), "row", None, 140),   # ny % (32*8) != 0
+        ((2048, 120), "row", None, 140),   # nx % 128 != 0 (patched rolls)
+        ((1024, 192), "cart", (4, 2), 100),  # 96-col shards, narrow pitch
+    ],
+)
+def test_parity_bitfused_unaligned(make_board, shape, layout, mesh_args, steps):
+    """Arbitrary board shapes through the packed fused path: the torus
+    lives in a word/lane-aligned padded frame with periodic mirrors and
+    funnel-shifted wrap halos (ops.bitlife module docs); every
+    combination must stay bit-exact across fused-round boundaries."""
+    board = make_board(*shape, density=0.35)
+    mesh = mesh_lib.make_mesh_2d(*mesh_args) if mesh_args else None
+    cfg = config_from_board(board, steps=steps, save_steps=1000)
+    sim = LifeSim(cfg, layout=layout, impl="bitfused", mesh=mesh)
+    assert steps > sim._plan.k_max, "steps must cross a fused round"
+    sim.step(steps)
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, steps))
+
+
 def test_bitfused_gates(make_board):
     with pytest.raises(ValueError, match="sharded layout"):
         LifeSim(config_from_board(make_board(2048, 128), 1, 1),
                 layout="serial", impl="bitfused")
-    # cart shard columns must be 128-aligned: 256/2 ok, 192/2 = 96 not.
-    with pytest.raises(ValueError, match="128-aligned"):
-        LifeSim(config_from_board(make_board(1024, 192), 1, 1),
+    # Genuinely unplannable: 64 rows over 8 shards leaves no room for a
+    # fused halo next to the 192 frame-padding rows.
+    with pytest.raises(ValueError, match="can't plan"):
+        LifeSim(config_from_board(make_board(64, 128), 1, 1),
+                layout="row", impl="bitfused")
+    # Same on a 2-D mesh: 20-column shards can't feed an 8-column x halo.
+    with pytest.raises(ValueError, match="can't plan"):
+        LifeSim(config_from_board(make_board(256, 20), 1, 1),
                 layout="cart", impl="bitfused",
                 mesh=mesh_lib.make_mesh_2d(4, 2))
-    # ny not divisible by 32*p (8 devices): 2040 % 256 != 0.
-    with pytest.raises(ValueError, match="32\\*mesh_y-aligned"):
-        LifeSim(config_from_board(make_board(2040, 128), 1, 1),
-                layout="row", impl="bitfused")
-    # nx not 128-aligned.
-    with pytest.raises(ValueError, match="128-aligned shard columns"):
-        LifeSim(config_from_board(make_board(2048, 120), 1, 1),
-                layout="row", impl="bitfused")
 
 
 def test_parity_explicit_meshes(make_board):
