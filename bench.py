@@ -62,53 +62,75 @@ def main(argv=None) -> int:
                           "error": "parity check failed"}))
         return 1
 
+    def measure(sim):
+        """(best_sec, steady_sec, differenced) for STEPS steps.
+
+        Steady-state rate: the single-run number carries one fixed
+        host->device dispatch round trip (~70 ms on a tunneled axon chip —
+        measured via a scalar fetch; a co-located host pays ~none), which
+        swamps the few-ms compute. On the pallas/bitfused paths the step
+        count is a runtime scalar, so a mult-x-longer dispatch reuses the
+        same executable; differencing the two durations isolates the
+        marginal per-step rate. The other impls jit with a static step
+        count (the longer dispatch would recompile — and on CPU also
+        grind through mult-x the steps), so they just report the
+        end-to-end number.
+        """
+        sim.warmup()  # compiles the exact stepper the timed loop uses
+        best = float("inf")
+        for _ in range(3):
+            sim.reset()
+            sim.sync()  # absorb reset()'s async host->device transfer
+            t0 = time.perf_counter()
+            sim.step(STEPS)
+            sim.sync()
+            best = min(best, time.perf_counter() - t0)
+        steady, differenced = best, False
+        if sim.impl in ("pallas", "bitfused"):
+            # RTT-bound sub-second runs: make the differencing signal
+            # large vs the ~±10 ms RTT jitter (161x chain ≈ 0.3 s of pure
+            # compute at the flagship rate → jitter is <5% of signal) and
+            # take best-of-3. Multi-second big-board runs: jitter is
+            # negligible and a 6x chain already costs real chip time —
+            # single shot.
+            rtt_bound = best < 1.0
+            mult, reps = (161, 3) if rtt_bound else (6, 1)
+            chained = float("inf")
+            for _ in range(reps):
+                sim.reset()
+                sim.sync()
+                t0 = time.perf_counter()
+                sim.step(STEPS * mult)
+                sim.sync()
+                chained = min(chained, time.perf_counter() - t0)
+            if chained > best:
+                steady = (chained - best) / (mult - 1)
+                differenced = True
+        return best, steady, differenced
+
     cfg = config_from_board(board, steps=STEPS, save_steps=0)
     sim = LifeSim(cfg, layout="serial", impl="auto")
-    # Warm-up compiles the exact stepper the timed loop uses (same instance,
-    # same static step count).
-    sim.warmup()
-
-    best = float("inf")
-    for _ in range(3):
-        sim.reset()
-        sim.sync()  # absorb reset()'s async host->device transfer
-        t0 = time.perf_counter()
-        sim.step(STEPS)
-        sim.sync()
-        best = min(best, time.perf_counter() - t0)
-
-    # Steady-state rate: the single-run number above carries one fixed
-    # host->device dispatch round trip (~70 ms on a tunneled axon chip —
-    # measured via a scalar fetch; a co-located host pays ~none), which
-    # swamps the few-ms compute. On the pallas path the step count is a
-    # runtime scalar, so a mult-x-longer dispatch reuses the same
-    # executable; differencing the two durations isolates the marginal
-    # per-step rate. The other impls jit with a static step count (the
-    # longer dispatch would recompile — and on CPU also grind through
-    # mult-x the steps), so they just report the end-to-end number.
-    steady = best
-    differenced = False
-    if sim.impl == "pallas":
-        # RTT-bound sub-second runs: make the differencing signal large
-        # vs the ~±10 ms RTT jitter (161x chain ≈ 0.3 s of pure compute
-        # at the flagship rate → jitter is <5% of signal) and take
-        # best-of-3. Multi-second big-board runs: jitter is negligible
-        # and a 6x chain already costs real chip time — single shot.
-        rtt_bound = best < 1.0
-        mult, reps = (161, 3) if rtt_bound else (6, 1)
-        chained = float("inf")
-        for _ in range(reps):
-            sim.reset()
-            sim.sync()
-            t0 = time.perf_counter()
-            sim.step(STEPS * mult)
-            sim.sync()
-            chained = min(chained, time.perf_counter() - t0)
-        if chained > best:
-            steady = (chained - best) / (mult - 1)
-            differenced = True
+    best, steady, differenced = measure(sim)
     cups = NY * NX * STEPS / best
     steady_cups = NY * NX * STEPS / steady
+
+    # Secondary: the SHARDED flagship path (row-layout bitfused over a
+    # 1-device mesh on the single bench chip) — the packed ppermute-halo
+    # machinery every multi-chip run rides, incl. the padded-frame wrap
+    # for the unaligned 500x500 board. TPU-only (interpret-mode Pallas
+    # would grind on CPU).
+    sharded = {}
+    if jax.default_backend() == "tpu":
+        from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+        sim_sh = LifeSim(cfg, layout="row", impl="bitfused",
+                         mesh=mesh_lib.make_mesh_1d(1, axis="y"))
+        _, steady_sh, diff_sh = measure(sim_sh)
+        sharded = {
+            "sharded_steady_cups": round(NY * NX * STEPS / steady_sh, 1),
+            "sharded_steady_is_differenced": diff_sh,
+            "sharded_plan": sim_sh._plan.mode,
+        }
     print(json.dumps({
         "metric": "life_steady_cups_p46gun_big",
         "value": round(steady_cups, 1),
@@ -123,6 +145,7 @@ def main(argv=None) -> int:
         "steady_is_differenced": differenced,
         "backend": jax.default_backend(),
         "impl": sim.impl,
+        **sharded,
     }))
     return 0
 

@@ -373,20 +373,6 @@ def fused_bits_supported(shape: tuple[int, int]) -> bool:
 _FUSE_HALO_X = 128
 
 
-def fused_row_sharded_supported(shape: tuple[int, int], p: int) -> bool:
-    """Whether the row-sharded bitfused path runs ``shape`` over a
-    ``p``-way ring — any board the frame-padding plan accepts (see
-    :func:`plan_sharded_bits`), alignment no longer required."""
-    return plan_sharded_bits(shape, p, 1, True, False) is not None
-
-
-def fused_cart_sharded_supported(
-    shape: tuple[int, int], py: int, px: int
-) -> bool:
-    """Same for the 2-D cart bitfused path (``py=1``: column strips)."""
-    return plan_sharded_bits(shape, py, px, True, True) is not None
-
-
 def _col_tile_plan(
     nw: int, nxl: int, tile_budget_bytes: int = _PACKED_VMEM_LIMIT
 ):
@@ -435,7 +421,7 @@ def make_fused_stepper(
             raise ValueError(
                 f"no legal fused tile split for extended shape "
                 f"{(nw, w_ext)}; gate callers on fused_bits_supported() / "
-                "fused_cart_sharded_supported()"
+                "plan_sharded_bits()"
             )
         _, tr, cx = plan
         grid = (nw // tr, nxl // cx)
@@ -572,6 +558,11 @@ def make_window_stepper(
     halo-extended window is then a few KB — exactly the VMEM-resident
     regime. Same calling convention as the tiled stepper.
     """
+    # Wrap-patched rolls assume board column 0 sits at lane 0 — an x
+    # border would shift it to lane halo_x and silently corrupt the wrap.
+    assert halo_x == 0 or nx_exact is None, (
+        "wrap-patched rolls need the unextended board width"
+    )
     w_ext = nxl + 2 * halo_x
 
     def kernel(k_ref, ext_ref, out_ref):

@@ -182,6 +182,21 @@ def test_auto_impl_selection(make_board):
         LifeSim(cfg2, layout="row", impl="halo")
 
 
+def test_auto_selects_bitfused_on_tpu(monkeypatch, make_board):
+    """On a TPU backend, auto must route the unaligned flagship geometry
+    (500x500, any mesh) onto the packed fused path — construction only,
+    so the faked backend never has to compile Mosaic on CPU."""
+    import mpi_and_open_mp_tpu.models.life as life_mod
+
+    monkeypatch.setattr(life_mod.jax, "default_backend", lambda: "tpu")
+    cfg = config_from_board(make_board(500, 500), steps=4, save_steps=10)
+    for layout in ("row", "col", "cart"):
+        assert LifeSim(cfg, layout=layout, impl="auto").impl == "bitfused"
+    # Geometry the planner rejects still falls back.
+    cfg2 = config_from_board(make_board(64, 128), steps=4, save_steps=10)
+    assert LifeSim(cfg2, layout="row", impl="auto").impl == "halo"
+
+
 def test_glider_fixture_end_to_end(tmp_path):
     """Full driver contract: cfg in, VTK snapshots out at the reference's
     cadence (save at i % save_steps == 0, before stepping)."""
