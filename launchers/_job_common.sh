@@ -1,0 +1,48 @@
+# Shared helpers for the job_* multi-process launchers (sourced, not run).
+#
+# Process topology travels in the environment — JOB_COORDINATOR
+# (host:port), JOB_NUM_PROCS, JOB_PROC_ID — the role PBS's $PBS_NODEFILE +
+# mpirun played for the reference (/root/reference/3-life/job_life.sh:2-8).
+# The framework CLIs consume it via --distributed (apps/_common.py).
+
+# Best-effort free port. Inherent TOCTOU: the port is released before
+# rank 0's coordinator binds it, so a concurrent process can steal it in
+# between (the failure is loud — the sweep dies or times out, not silent
+# corruption). Export JOB_PORT to pin a known-free port instead.
+free_port() {
+  if [[ -n "${JOB_PORT:-}" ]]; then
+    echo "$JOB_PORT"
+    return
+  fi
+  python - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("localhost", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+}
+
+# run_ranks NP CMD...: spawn NP ranks of CMD on this machine (CPU backend,
+# one device per process — the single-machine stand-in for a DCN pod; the
+# mechanism tests/test_distributed.py proves) and wait for all of them.
+# Under a real scheduler this function is what srun/pbsdsh replaces: each
+# rank just runs CMD with the three JOB_* variables exported.
+run_ranks() {
+  local np="$1"; shift
+  local port
+  port=$(free_port)
+  local pids=() i
+  for i in $(seq 0 $((np - 1))); do
+    env -u XLA_FLAGS JAX_PLATFORMS=cpu \
+      JOB_COORDINATOR="localhost:$port" \
+      JOB_NUM_PROCS="$np" JOB_PROC_ID="$i" \
+      "$@" &
+    pids+=($!)
+  done
+  local rc=0 pid
+  for pid in "${pids[@]}"; do
+    wait "$pid" || rc=$?
+  done
+  return "$rc"
+}

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Multi-process quadrature scaling sweep — the analogue of the reference's
+# PBS batch script (/root/reference/1-integral/job_integral.sh:2-8, sweep
+# np=1..28 of mpi_integral 1e12). N defaults to 1e9 locally; the reference's
+# documented 1e12 runs actually computed N mod 2^32 (SURVEY §2 quirks) —
+# pass --n=1000000000000 for the true thing on a pod.
+#
+# Usage:
+#   launchers/job_integral.sh [--n=N] [--max-procs=N] [--times-file=FILE]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source launchers/_job_common.sh
+
+N=1000000000
+MAXPROCS=4
+TIMES=times_integral_job.txt
+for arg in "$@"; do
+  case "$arg" in
+    --n=*)          N="${arg#*=}" ;;
+    --max-procs=*)  MAXPROCS="${arg#*=}" ;;
+    --times-file=*) TIMES="${arg#*=}" ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+for np in $(seq 1 "$MAXPROCS"); do
+  run_ranks "$np" python -m mpi_and_open_mp_tpu.apps.integral "$N" \
+    --devices "$np" --distributed --times-file "$TIMES"
+done
+echo "wrote $TIMES" >&2

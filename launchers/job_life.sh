@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Multi-process (multi-host-style) Life scaling sweep — the analogue of the
+# reference's PBS batch script (/root/reference/3-life/job_life.sh:2-8:
+# 7 nodes x 4 ppn, sweep np=1..28, one wall-seconds line per np appended to
+# times.txt by one rank).
+#
+# Scheduler-agnostic: each rank is one invocation of the framework CLI with
+# --distributed; topology travels in the JOB_* environment (see
+# _job_common.sh). Run locally (default) and this script spawns the ranks
+# itself; under a real scheduler, have each rank run
+#
+#   python -m mpi_and_open_mp_tpu.apps.life CFG --distributed ...
+#
+# with JOB_COORDINATOR/JOB_NUM_PROCS/JOB_PROC_ID exported per rank (e.g.
+# srun --export=... or a pbsdsh wrapper) — run_ranks below is exactly the
+# part the scheduler replaces.
+#
+# Usage:
+#   launchers/job_life.sh [--cfg=FILE] [--max-procs=N] [--layout=...]
+#                         [--times-file=FILE]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source launchers/_job_common.sh
+
+CFG=configs/gun_big_500x500.cfg
+MAXPROCS=4
+LAYOUT=row
+TIMES=times_job.txt
+for arg in "$@"; do
+  case "$arg" in
+    --cfg=*)        CFG="${arg#*=}" ;;
+    --max-procs=*)  MAXPROCS="${arg#*=}" ;;
+    --layout=*)     LAYOUT="${arg#*=}" ;;
+    --times-file=*) TIMES="${arg#*=}" ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+for np in $(seq 1 "$MAXPROCS"); do
+  run_ranks "$np" python -m mpi_and_open_mp_tpu.apps.life "$CFG" \
+    --layout "$LAYOUT" --distributed --times-file "$TIMES"
+done
+echo "wrote $TIMES; plot with: python analysis/plot_life.py $TIMES" >&2

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Fabric probe at the two reference placements
+# (/root/reference/2-network-params/job_single.sh:2 — 2 ranks, 1 node =
+# shared-memory transport — vs job_mult.sh:2 — 1 rank on each of 2 nodes =
+# NIC transport). The TPU-era contrast: "single" runs both ring members in
+# one process (in-process XLA transfers — the ICI stand-in), "mult" runs
+# one device per process over the distributed backend (the DCN stand-in).
+# Each writes the reference CSV schema (out_single.csv / out_mult.csv) for
+# plot.ipynb / analysis/plot_network.py.
+#
+# Usage:
+#   launchers/job_pingpong.sh [--placement=single|mult] [--reps=N]
+#                             [--out=FILE]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source launchers/_job_common.sh
+
+PLACEMENT=mult
+REPS=100
+MAXPOWER=6
+OUT=""
+for arg in "$@"; do
+  case "$arg" in
+    --placement=*) PLACEMENT="${arg#*=}" ;;
+    --reps=*)      REPS="${arg#*=}" ;;
+    --max-power=*) MAXPOWER="${arg#*=}" ;;
+    --out=*)       OUT="${arg#*=}" ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+if [[ "$PLACEMENT" == single ]]; then
+  OUT="${OUT:-out_single.csv}"
+  env -u XLA_FLAGS python -m mpi_and_open_mp_tpu.apps.pingpong \
+    --devices 2 --virtual-devices 2 --reps "$REPS" \
+    --max-power "$MAXPOWER" --out "$OUT"
+else
+  OUT="${OUT:-out_mult.csv}"
+  run_ranks 2 python -m mpi_and_open_mp_tpu.apps.pingpong \
+    --distributed --reps "$REPS" --max-power "$MAXPOWER" --out "$OUT"
+fi
+echo "wrote $OUT" >&2

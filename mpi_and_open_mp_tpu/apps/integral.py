@@ -14,7 +14,8 @@ import argparse
 import sys
 import time
 
-from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
+from mpi_and_open_mp_tpu.apps._common import (
+    add_platform_args, apply_platform_args, is_primary)
 from mpi_and_open_mp_tpu.models.integral import Integral
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils.timing import append_times_txt
@@ -43,11 +44,12 @@ def main(argv=None) -> int:
     value = integral.compute()
     elapsed = time.perf_counter() - t0
 
-    print(f"{elapsed:.6f}")
-    if args.times_file:
-        append_times_txt(args.times_file, elapsed)
-    if args.print_value:
-        print(f"{value!r}", file=sys.stderr)
+    if is_primary():  # print-from-one-rank (1-integral/integral.c:45-46)
+        print(f"{elapsed:.6f}")
+        if args.times_file:
+            append_times_txt(args.times_file, elapsed)
+        if args.print_value:
+            print(f"{value!r}", file=sys.stderr)
     return 0
 
 

@@ -21,7 +21,8 @@ import numpy as np
 
 import jax
 
-from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
+from mpi_and_open_mp_tpu.apps._common import (
+    add_platform_args, apply_platform_args, is_primary)
 from mpi_and_open_mp_tpu.models.life import IMPLS, LAYOUTS, LifeSim
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils.config import load_config
@@ -159,11 +160,14 @@ def main(argv=None) -> int:
     if args.debug_check:
         sim.debug_check()
 
-    print(f"{elapsed:.6f}")
-    if args.times_file:
-        append_times_txt(args.times_file, elapsed)
-    if args.print_final_population:
-        print(int(np.asarray(final).sum()), file=sys.stderr)
+    # One process owns stdout and the times file — the reference's
+    # print-from-one-rank discipline (3-life/life_mpi.c:64-67).
+    if is_primary():
+        print(f"{elapsed:.6f}")
+        if args.times_file:
+            append_times_txt(args.times_file, elapsed)
+        if args.print_final_population:
+            print(int(np.asarray(final).sum()), file=sys.stderr)
     return 0
 
 

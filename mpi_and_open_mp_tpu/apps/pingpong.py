@@ -11,7 +11,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_args
+from mpi_and_open_mp_tpu.apps._common import (
+    add_platform_args, apply_platform_args, is_primary)
 from mpi_and_open_mp_tpu.parallel import fabric, mesh as mesh_lib
 
 
@@ -31,14 +32,16 @@ def main(argv=None) -> int:
     sizes = tuple(10**k for k in range(args.max_power + 1))
     rows = fabric.sweep(mesh, sizes=sizes, reps=args.reps)
 
-    print("size,time")
-    for s, us in rows:
-        print(f"{s},{us:.6f}")
-    if args.out:
-        fabric.write_csv(args.out, rows)
-    if args.fit:
-        alpha, bw = fabric.fit_alpha_beta(rows)
-        print(f"alpha={alpha:.3f}us bandwidth={bw:.1f}MB/s", file=sys.stderr)
+    if is_primary():  # CSV-from-one-rank (mpi_send_recv.c:36-39 rank 0)
+        print("size,time")
+        for s, us in rows:
+            print(f"{s},{us:.6f}")
+        if args.out:
+            fabric.write_csv(args.out, rows)
+        if args.fit:
+            alpha, bw = fabric.fit_alpha_beta(rows)
+            print(f"alpha={alpha:.3f}us bandwidth={bw:.1f}MB/s",
+                  file=sys.stderr)
     return 0
 
 

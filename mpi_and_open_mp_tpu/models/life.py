@@ -519,10 +519,15 @@ class LifeSim:
         ``advance`` is jit-cached per static step count ON THIS INSTANCE, so
         warm-up must use the same instance and the same counts; it runs each
         compiled program once on the current board and discards the result
-        (``advance`` is functional — state is untouched).
+        (``advance`` is functional — state is untouched). Synchronisation
+        goes through ``anchor_sync`` (not a whole-array fetch): on
+        multi-host runs the board spans non-addressable devices, where a
+        full ``device_get`` is impossible.
         """
+        from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
         for n in self._segment_lengths():
-            jax.device_get(self._advance(self.board, n))
+            anchor_sync(self._advance(self.board, n), fetch_all=True)
 
     def collect(self) -> np.ndarray:
         """Gather the global board to the host (uint8 ``(ny, nx)``).

@@ -63,13 +63,18 @@ def ping(mesh: Mesh, msg_bytes: int, reps: int = 100) -> float:
     n = max(1, msg_bytes)
     buf = jnp.zeros((p * n,), dtype=jnp.int8)
     buf = jax.device_put(buf, NamedSharding(mesh, P(axis)))
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
     # Warm-up: compile + first transfer.
-    jax.device_get(_ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh))
+    anchor_sync(_ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh),
+                fetch_all=True)
     t0 = time.perf_counter()
     out = _ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh)
-    # device_get, not block_until_ready: the latter is a no-op on some
-    # platforms (observed on the axon TPU tunnel).
-    np.asarray(jax.device_get(out[:1]))
+    # Anchored one-element fetch, not bare block_until_ready: the latter
+    # is a no-op on some platforms (observed on the axon TPU tunnel);
+    # the anchor reads a locally addressable shard, so it also works on
+    # multi-process meshes where a global fetch is impossible.
+    anchor_sync(out, fetch_all=True)
     elapsed = time.perf_counter() - t0
     return elapsed / reps
 
