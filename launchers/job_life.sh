@@ -17,7 +17,10 @@
 #
 # Usage:
 #   launchers/job_life.sh [--cfg=FILE] [--max-procs=N] [--layout=...]
-#                         [--times-file=FILE]
+#                         [--times-file=FILE] [--fuse-steps=K]
+# --fuse-steps=K exchanges one depth-K halo per K local steps — the lever
+# that amortises the (expensive) cross-process exchange, cf. the depth-k
+# ghost option discussed at SURVEY.md §7 hard-part (4).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 source launchers/_job_common.sh
@@ -26,18 +29,21 @@ CFG=configs/gun_big_500x500.cfg
 MAXPROCS=4
 LAYOUT=row
 TIMES=times_job.txt
+FUSE=1
 for arg in "$@"; do
   case "$arg" in
     --cfg=*)        CFG="${arg#*=}" ;;
     --max-procs=*)  MAXPROCS="${arg#*=}" ;;
     --layout=*)     LAYOUT="${arg#*=}" ;;
     --times-file=*) TIMES="${arg#*=}" ;;
+    --fuse-steps=*) FUSE="${arg#*=}" ;;
     *) echo "unknown arg: $arg" >&2; exit 2 ;;
   esac
 done
 
 for np in $(seq 1 "$MAXPROCS"); do
   run_ranks "$np" python -m mpi_and_open_mp_tpu.apps.life "$CFG" \
-    --layout "$LAYOUT" --distributed --times-file "$TIMES"
+    --layout "$LAYOUT" --fuse-steps "$FUSE" --distributed \
+    --times-file "$TIMES"
 done
 echo "wrote $TIMES; plot with: python analysis/plot_life.py $TIMES" >&2

@@ -3,7 +3,8 @@
 # trapezoids — for real this time; the reference's atoi truncated it to
 # ~3.57e9 (see BASELINE.md). Appends seconds to times.txt.
 #
-# Usage: launchers/run_integral.sh [--backend=tpu|mpi] [--n=N] [--max-dev=N] [--virtual]
+# Usage: launchers/run_integral.sh [--backend=tpu|mpi] [--n=N] [--max-dev=N]
+#        [--virtual] [--times-file=FILE]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -11,12 +12,14 @@ BACKEND=tpu
 N=1000000000000
 MAXDEV=8
 VIRTUAL=0
+TIMES=times.txt
 for arg in "$@"; do
   case "$arg" in
     --backend=*) BACKEND="${arg#*=}" ;;
     --n=*)       N="${arg#*=}" ;;
     --max-dev=*) MAXDEV="${arg#*=}" ;;
     --virtual)   VIRTUAL=1 ;;
+    --times-file=*) TIMES="${arg#*=}" ;;
     *) echo "unknown arg: $arg" >&2; exit 2 ;;
   esac
 done
@@ -25,7 +28,7 @@ if [[ "$BACKEND" == mpi ]]; then
   : "${MPI_INTEGRAL_BIN:?--backend=mpi needs MPI_INTEGRAL_BIN=/path/to/mpi_integral}"
   command -v mpirun >/dev/null || { echo "mpirun not found" >&2; exit 3; }
   for np in $(seq 1 "$MAXDEV"); do
-    /usr/bin/time -f %e -o times.txt -a \
+    /usr/bin/time -f %e -o "$TIMES" -a \
       mpirun -np "$np" --map-by :OVERSUBSCRIBE "$MPI_INTEGRAL_BIN" "$N"
   done
   exit 0
@@ -34,10 +37,10 @@ fi
 for np in $(seq 1 "$MAXDEV"); do
   if [[ "$VIRTUAL" == 1 ]]; then
     python -m mpi_and_open_mp_tpu.apps.integral "$N" \
-      --virtual-devices "$np" --devices "$np" --times-file times.txt
+      --virtual-devices "$np" --devices "$np" --times-file "$TIMES"
   else
     python -m mpi_and_open_mp_tpu.apps.integral "$N" \
-      --devices "$np" --times-file times.txt
+      --devices "$np" --times-file "$TIMES"
   fi
 done
-echo "wrote times.txt"
+echo "wrote $TIMES"

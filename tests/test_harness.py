@@ -123,3 +123,25 @@ def test_run_life_launcher_virtual(tmp_path):
     assert len(lines) == 2
     for l in lines:
         float(l)
+
+
+def test_committed_results_layer_parses():
+    """The recorded-measurement artifacts under results/ (the analogue of
+    the reference's committed times.txt / out_*.csv) must stay consumable
+    by the analysis layer."""
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import plot_life
+    import plot_network
+
+    results = os.path.join(REPO, "results")
+    for rel in ("life/times_virtual8.txt", "life/times_job2.txt",
+                "life/times_job2_fuse10.txt", "integral/times_virtual8.txt"):
+        times = plot_life.load_times(os.path.join(results, rel))
+        assert len(times) >= 2 and (times > 0).all(), rel
+    for rel in ("network/out_single.csv", "network/out_mult.csv",
+                "network/out_tpu_loopback.csv"):
+        rows = plot_network.load_csv(os.path.join(results, rel))
+        assert len(rows) == 7 and rows[0][0] == 1, rel
+        assert all(t > 0 for _, t in rows), rel
+    for png in ("life/life_accel_virtual8.png", "network/network_params.png"):
+        assert os.path.getsize(os.path.join(results, png)) > 1000, png
