@@ -1,11 +1,13 @@
 """Randomised cross-configuration parity sweep.
 
 Deterministic (seeded) random sampling over the full configuration space
-— board shape (divisible or not), layout, mesh factorisation, impl,
-fusion depth, step count — every sample checked bit-exact against the
-NumPy oracle. Catches interaction bugs the per-feature tests can miss
-(e.g. a layout×fuse×uneven-shape corner); the seed makes failures
-reproducible.
+— board shape (divisible, uneven, or planner-shaped unaligned), layout,
+mesh factorisation, ALL FOUR impls (roll/halo/pallas/bitfused), fusion
+depth, step count — every sample checked bit-exact against the NumPy
+oracle. Catches interaction bugs the per-feature tests can miss (e.g. a
+layout×fuse×uneven-shape corner, or a packed-frame wrap at one specific
+pad); the seed makes failures reproducible. A meta-test pins the sampled
+coverage so a sampler edit can't silently drop an impl from the sweep.
 """
 
 import numpy as np
@@ -14,6 +16,7 @@ import pytest
 from conftest import oracle_n, random_board
 
 from mpi_and_open_mp_tpu.models.life import LifeSim
+from mpi_and_open_mp_tpu.ops import bitlife
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils.config import config_from_board
 
@@ -23,27 +26,76 @@ MESHES = {
     "col": [(1, 8), (1, 4), (1, 2)],
     "cart": [(4, 2), (2, 4), (2, 2), (8, 1)],
 }
+N_CASES = 24
 
 
 def _sample(rng):
     layout = rng.choice(list(MESHES))
     py, px = MESHES[layout][rng.integers(len(MESHES[layout]))] or (1, 1)
-    if rng.random() < 0.7:  # divisible board
+    r = rng.random()
+    if layout == "serial":
+        ny, nx = int(rng.integers(5, 60)), int(rng.integers(5, 60))
+        impl = str(rng.choice(["roll", "pallas"]))
+        fuse, steps = 1, int(rng.integers(1, 13))
+    elif r < 0.40:  # divisible board: the shard_map impls
         ny = py * int(rng.integers(2, 9))
         nx = px * int(rng.integers(2, 9))
-        impl = rng.choice(["roll", "halo"]) if layout != "serial" else "roll"
-    else:  # uneven board -> roll only
+        impl = str(rng.choice(["roll", "halo", "pallas"]))
+        fuse = int(rng.integers(1, 4)) if impl in ("halo", "pallas") else 1
+        if fuse > min(ny // py, nx // px):
+            fuse = 1
+        steps = int(rng.integers(1, 13))
+    elif r < 0.60:  # small uneven board -> global roll
         ny = int(rng.integers(5, 50))
         nx = int(rng.integers(5, 50))
-        impl = "roll"
-    fuse = int(rng.integers(1, 4)) if impl == "halo" else 1
-    if fuse > min(ny // py, nx // px):
-        fuse = 1
-    steps = int(rng.integers(1, 13))
+        impl, fuse, steps = "roll", 1, int(rng.integers(1, 13))
+    else:  # planner-shaped boards, any alignment -> packed fused path
+        y_sh, x_sh = layout in ("row", "cart"), layout in ("col", "cart")
+        plan = None
+        for _ in range(8):  # rejection-sample until the planner accepts
+            ny = int(rng.integers(64, 200)) * py + int(rng.integers(0, 40))
+            nx = int(rng.integers(40, 260)) * px + int(rng.integers(0, 40))
+            plan = bitlife.plan_sharded_bits((ny, nx), py, px, y_sh, x_sh)
+            if plan is not None:
+                break
+        if plan is None:  # pathological mesh draw; keep the case useful
+            impl, fuse, steps = "roll", 1, int(rng.integers(1, 13))
+        else:
+            impl, fuse = "bitfused", 1
+            # Bias toward crossing a fused-round boundary when k_max is
+            # small (h=1 plans); huge-k plans stay single-round to keep
+            # the CPU oracle affordable.
+            steps = int(rng.integers(1, min(plan.k_max + 12, 60)))
     return layout, (py, px), ny, nx, impl, fuse, steps
 
 
-@pytest.mark.parametrize("case", range(15))
+def _cases():
+    return [
+        _sample(np.random.default_rng(46_000 + case))
+        for case in range(N_CASES)
+    ]
+
+
+def test_sweep_covers_all_impls():
+    """The seeded draw must keep exercising every impl and at least one
+    bitfused sample that crosses a fused-round boundary."""
+    cases = _cases()
+    impls = {c[4] for c in cases}
+    assert impls == {"roll", "halo", "pallas", "bitfused"}, impls
+    crossing = []
+    for layout, (py, px), ny, nx, impl, _, steps in cases:
+        if impl != "bitfused":
+            continue
+        plan = bitlife.plan_sharded_bits(
+            (ny, nx), py, px,
+            layout in ("row", "cart"), layout in ("col", "cart"))
+        if steps > plan.k_max:  # a second round re-consumes round-1 halos
+            crossing.append((layout, ny, nx, plan.k_max, steps))
+    assert crossing, "no bitfused sample crosses its fused-round boundary"
+    assert any(c[0] == "cart" and c[4] == "bitfused" for c in cases)
+
+
+@pytest.mark.parametrize("case", range(N_CASES))
 def test_random_config_parity(case):
     rng = np.random.default_rng(46_000 + case)
     layout, (py, px), ny, nx, impl, fuse, steps = _sample(rng)
