@@ -12,7 +12,9 @@ Life halo exchange (``parallel.halo.ring_perm`` + ``lax.ppermute`` inside
 * ``ring_attention`` — sequence-sharded attention where K/V blocks rotate
   around the ring, one hop per step, combined with an online-softmax
   (flash-style) running max/sum so the full score matrix never materialises.
-  Comm rides ICI ``ppermute`` exactly like the ghost-row exchange; compute
+  Comm rides ICI ``ppermute`` exactly like the ghost-row exchange, and is
+  double-buffered: each hop issues the next rotation BEFORE folding the
+  block in hand, so the transfer overlaps the MXU block matmuls; compute
   per hop is a dense (n_local x n_local) block that maps onto the MXU.
 * ``ulysses_attention`` — the all-to-all alternative: ``lax.all_to_all``
   re-shards from sequence-parallel to head-parallel, runs full local
@@ -206,12 +208,20 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
 
     def hop(j, carry):
         o, m, l, kb, vb = carry
+        # Double-buffered rotation: issue the NEXT hop's K/V transfer
+        # before folding the block just received, so the async
+        # collective-permute rides the fabric while the MXU computes the
+        # score block (XLA's latency-hiding scheduler pairs the
+        # permute-start here with a permute-done after the fold — the
+        # fold reads only the held kb/vb, never the in-flight pair). The
+        # ppermutes stay unconditional and outside fold's causal `cond`:
+        # collectives inside a per-device branch would deadlock the ring.
+        kb_next = lax.ppermute(kb, axis, perm)
+        vb_next = lax.ppermute(vb, axis, perm)
         o, m, l = fold(j, o, m, l, kb, vb)
-        kb = lax.ppermute(kb, axis, perm)
-        vb = lax.ppermute(vb, axis, perm)
-        return o, m, l, kb, vb
+        return o, m, l, kb_next, vb_next
 
-    # p-1 compute+rotate hops, then a final fold with no trailing rotation
+    # p-1 rotate+compute hops, then a final fold with no trailing rotation
     # (the p-th ppermute pair would only feed discarded loop carries).
     o, m, l, kb, vb = lax.fori_loop(0, p - 1, hop, (o0, m0, l0, k, v))
     o, m, l = fold(p - 1, o, m, l, kb, vb)
