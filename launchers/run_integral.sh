@@ -25,8 +25,11 @@ for arg in "$@"; do
 done
 
 if [[ "$BACKEND" == mpi ]]; then
-  : "${MPI_INTEGRAL_BIN:?--backend=mpi needs MPI_INTEGRAL_BIN=/path/to/mpi_integral}"
   command -v mpirun >/dev/null || { echo "mpirun not found" >&2; exit 3; }
+  if [[ -z "${MPI_INTEGRAL_BIN:-}" ]]; then
+    make -C mpi_baseline build/mpi_integral
+    MPI_INTEGRAL_BIN=mpi_baseline/build/mpi_integral
+  fi
   for np in $(seq 1 "$MAXDEV"); do
     /usr/bin/time -f %e -o "$TIMES" -a \
       mpirun -np "$np" --map-by :OVERSUBSCRIBE "$MPI_INTEGRAL_BIN" "$N"

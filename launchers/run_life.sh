@@ -13,10 +13,12 @@
 #                  1..max-dev over the real devices. Pass --virtual to run
 #                  the sweep on virtual CPU devices instead (required on a
 #                  single-chip host when max-dev > 1).
-#   --backend=mpi  run the original MPI reference binary via mpirun, if
-#                  MPI_LIFE_BIN points at a built binary and mpirun exists
-#                  (kept for side-by-side baselines; this repo does not
-#                  ship the MPI build).
+#   --backend=mpi  run the original MPI reference program via mpirun for a
+#                  side-by-side baseline. Self-contained: the binary is
+#                  built on demand from the reference sources
+#                  (mpi_baseline/Makefile, layout-matched variant) when
+#                  MPI_LIFE_BIN doesn't already point at one. Needs an MPI
+#                  toolchain (mpicc + mpirun) on PATH.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -39,8 +41,17 @@ for arg in "$@"; do
 done
 
 if [[ "$BACKEND" == mpi ]]; then
-  : "${MPI_LIFE_BIN:?--backend=mpi needs MPI_LIFE_BIN=/path/to/life_mpi}"
   command -v mpirun >/dev/null || { echo "mpirun not found" >&2; exit 3; }
+  if [[ -z "${MPI_LIFE_BIN:-}" ]]; then
+    case "$LAYOUT" in
+      row)  BIN=life_mpi ;;
+      col)  BIN=life_col ;;
+      cart) BIN=life_cart ;;
+      *) echo "--backend=mpi maps layouts row/col/cart only" >&2; exit 2 ;;
+    esac
+    make -C mpi_baseline "build/$BIN"
+    MPI_LIFE_BIN="mpi_baseline/build/$BIN"
+  fi
   for np in $(seq 1 "$MAXDEV"); do
     /usr/bin/time -f %e -o "$TIMES" -a \
       mpirun -np "$np" --map-by :OVERSUBSCRIBE "$MPI_LIFE_BIN" "$CFG"

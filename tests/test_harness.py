@@ -145,3 +145,38 @@ def test_committed_results_layer_parses():
         assert all(t > 0 for _, t in rows), rel
     for png in ("life/life_accel_virtual8.png", "network/network_params.png"):
         assert os.path.getsize(os.path.join(results, png)) > 1000, png
+
+
+def test_mpi_baseline_serial_oracle_builds_and_matches():
+    """mpi_baseline/Makefile must compile the reference's serial oracle
+    from the read-only reference tree and its VTK output must agree with
+    this framework's oracle — the self-contained --backend=mpi
+    prerequisite (SURVEY §7 step 7). MPI binaries need mpicc (absent in
+    this image); the serial target proves the build plumbing."""
+    import shutil
+    import tempfile
+
+    ref = "/root/reference"
+    if not os.path.isdir(ref):
+        pytest.skip("reference tree not present")
+    repo = REPO
+    r = subprocess.run(
+        ["make", "-C", os.path.join(repo, "mpi_baseline"), "life2d",
+         f"REF_DIR={ref}"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    binary = os.path.join(repo, "mpi_baseline", "build", "life2d")
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg_path = os.path.join(repo, "configs", "glider_10x10.cfg")
+        shutil.copy(cfg_path, tmp)
+        r = subprocess.run(
+            [binary, "glider_10x10.cfg"], cwd=tmp,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        from mpi_and_open_mp_tpu.utils.vtk import read_vtk
+
+        cfg = load_config_py(cfg_path)
+        got = read_vtk(os.path.join(tmp, "life_000075.vtk"))
+        np.testing.assert_array_equal(got, oracle_n(cfg.board(), 75))
