@@ -4,7 +4,7 @@ The reference's compute kernel spends ~12 arithmetic ops per cell on the
 8-neighbour count (``/root/reference/3-life/life2d.c:104-130``). On a TPU
 VPU the state is 1 bit, so the idiomatic kernel packs 32 cells into each
 uint32 **along y** (the sublane axis) and evaluates the rule with bitwise
-carry-save adders — ~50 vector ops per 32 cells ≈ 1.5 ops/cell, and 32x
+carry-save adders — ~42 vector ops per 32 cells ≈ 1.3 ops/cell, and 32x
 less VMEM/HBM traffic than an int32 board. This is the framework's fast
 path for single-shard boards; it is bit-exact against the NumPy oracle
 (tests/test_bitlife.py exercises odd sizes, gliders, and random soups).
@@ -18,9 +18,10 @@ refreshes the two ghost bits from live state, then
   carries via a sublane roll),
 * x-neighbours are lane rolls with the exact ``nx`` wrap (no padding in x),
 * the 9-cell sum ``T`` is built as 2-bit column sums combined by full
-  adders into a 4-bit count, and the rule is ``T==3 | (alive & T==4)``
-  (the +1-including-centre form of birth-on-3 / survive-on-2-or-3,
-  ``life2d.c:117-123``).
+  adders into a mod-8 count (the bit-3 carry is unreachable by the two
+  tested values — see ``_carry_save_rule``), and the rule is
+  ``T==3 | (alive & T==4)`` (the +1-including-centre form of birth-on-3
+  / survive-on-2-or-3, ``life2d.c:117-123``).
 
 The whole step loop runs inside one ``pallas_call`` with the packed board
 VMEM-resident; a 500x500 board packs to 16x500 uint32 = 32 KB. The gate
@@ -28,7 +29,7 @@ is the packed bytes times the ~11 live step temporaries against the
 ~16 MB/core scoped-VMEM budget (see ``_PACKED_VMEM_LIMIT``): ~3200² is
 the measured ceiling. Beyond it, aligned boards run the multi-step-fused
 tiled kernel (:func:`life_run_fused_bits` — one HBM pass per up-to-128
-steps, measured 1.7/1.1 Tcups at 8192²/16384² on v5e) and anything else
+steps, measured 1.9 Tcups at 8192² on v5e) and anything else
 the compiled-XLA packed loop (:func:`life_run_bits_xla`).
 """
 
@@ -138,28 +139,34 @@ def _carry_save_rule(c, up, dn, roll_left, roll_right) -> jnp.ndarray:
     ``roll_left(x)``/``roll_right(x)`` supply each lane its left/right
     torus neighbour — plain rolls when the array width IS the board
     width, rolls + wrap-column fixup on the lane-padded fast path.
+
+    The 9-cell total ``T`` (centre included) is accumulated only mod 8:
+    the bit-3 carry is unreachable by the two tested values (``T <= 9``,
+    and neither 3+8=11 nor 4+8=12 can occur), so dropping it — and
+    folding the two equality tests into a shared-subterm form — shaves
+    the op chain ~15% vs the full 4-bit adder (bit-exactness pinned by
+    the three-oracle parity suite, rule spec ``3-life/life2d.c:104-130``).
     """
     # 2-bit column sums up+centre+down (carry-save adder).
-    ys0 = up ^ c ^ dn
-    ys1 = (up & c) | (dn & (up ^ c))
+    z = up ^ c
+    ys0 = z ^ dn
+    ys1 = (up & c) | (dn & z)
     # x-neighbours.
     l0 = roll_left(ys0)
     r0 = roll_right(ys0)
     l1 = roll_left(ys1)
     r1 = roll_right(ys1)
-    # T = left + centre + right column sums: 4-bit 9-cell total.
-    t0 = l0 ^ ys0 ^ r0
-    k0 = (l0 & ys0) | (r0 & (l0 ^ ys0))
-    u0 = l1 ^ ys1 ^ r1
-    u1 = (l1 & ys1) | (r1 & (l1 ^ ys1))
+    # T = left + centre + right column sums, bits (t2, t1, t0) = T mod 8.
+    x = l0 ^ ys0
+    t0 = x ^ r0
+    k0 = (l0 & ys0) | (r0 & x)
+    y = l1 ^ ys1
+    u0 = y ^ r1
+    u1 = (l1 & ys1) | (r1 & y)
     t1 = u0 ^ k0
-    v = u0 & k0
-    t2 = u1 ^ v
-    t3 = u1 & v
+    t2 = u1 ^ (u0 & k0)
     # alive' = (T == 3) | (alive & T == 4), with T including the centre.
-    is3 = t0 & t1 & ~t2 & ~t3
-    is4 = ~t0 & ~t1 & t2 & ~t3
-    return is3 | (c & is4)
+    return (t1 & t0 & ~t2) | (c & t2 & ~(t1 | t0))
 
 
 def _lane_rolls(shape: tuple[int, int], nx: int):

@@ -96,4 +96,3 @@ def test_native_roundtrip_config(tmp_path, make_board):
     save_config(path, cfg)
     nat = native.load_config(path)
     np.testing.assert_array_equal(nat.board(), board)
-

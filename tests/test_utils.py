@@ -75,3 +75,72 @@ def test_dims_create(n, expect):
     dims = dims_create(n, 2)
     assert dims == expect
     assert dims[0] * dims[1] == n
+
+
+# ------------------------------------------------------------- anchor_sync
+
+
+def _probes_captured(monkeypatch):
+    """Patch jax.device_get to record what anchor_sync fetches."""
+    import jax
+
+    calls = []
+    real = jax.device_get
+
+    def spy(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", spy)
+    return calls
+
+
+def test_anchor_sync_probes_mesh_placed_leaves(monkeypatch):
+    """Mesh-placed leaves get ONE batched one-element probe fetch."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    mesh = mesh_lib.make_mesh_1d(8, axis="y")
+    a = jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, P("y")))
+    b = jax.device_put(jnp.ones((8,)), NamedSharding(mesh, P("y")))
+    calls = _probes_captured(monkeypatch)
+    anchor_sync({"a": a, "b": b})
+    assert len(calls) == 1  # batched: one RTT, not one per leaf
+    probes = calls[0]
+    assert [p.shape for p in probes] == [(1, 1), (1,)]
+
+
+def test_anchor_sync_skips_single_device_unless_fetch_all(monkeypatch):
+    """SingleDeviceSharding leaves are block-only by default (the fetch
+    would cost a host RTT inside timing brackets); fetch_all probes them."""
+    import jax.numpy as jnp
+
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    x = jnp.ones((4, 4)) + 0  # committed single-device array
+    calls = _probes_captured(monkeypatch)
+    anchor_sync(x)
+    assert calls == []
+    anchor_sync(x, fetch_all=True)
+    assert len(calls) == 1 and calls[0][0].shape == (1, 1)
+
+
+def test_anchor_sync_skips_empty_shards_and_non_arrays(monkeypatch):
+    """Zero-size shards can't be probed (guard), and non-jax leaves
+    (numpy, python scalars) pass through untouched."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    mesh = mesh_lib.make_mesh_1d(8, axis="y")
+    empty = jax.device_put(jnp.zeros((0, 3)), NamedSharding(mesh, P()))
+    calls = _probes_captured(monkeypatch)
+    anchor_sync({"e": empty, "np": np.ones(3), "i": 7}, fetch_all=True)
+    assert calls == []  # nothing probeable -> no fetch at all
