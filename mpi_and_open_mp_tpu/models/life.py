@@ -360,7 +360,6 @@ class LifeSim:
         plan = self._plan
         mesh = self.mesh
         spec = _layout_spec(self.layout)
-        ny = self.cfg.ny
         interpret = jax.default_backend() != "tpu"
         step_call = bitlife.make_plan_stepper(plan, interpret=interpret)
         dtype = self.dtype
@@ -382,10 +381,8 @@ class LifeSim:
                     e = halo.packed_halo_x(e, "x", plan.hx, pad=plan.pad_x)
                 if plan.y_sharded:
                     e = halo.packed_halo_y(e, "y", plan.h, pad=plan.pad_y)
-                elif plan.pad_y:
-                    e = bitlife.wrap_y_padded(e, ny, plan.h)
                 else:
-                    e = bitlife.wrap_y(e, plan.h)
+                    e = bitlife.local_wrap_y(plan, e)
                 return step_call(k.reshape(1), e), rem - k
 
             q, _ = lax.while_loop(

@@ -54,19 +54,26 @@ def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     packed boards up to ~3200² stay VMEM-resident with the whole step loop
     in one kernel launch (interpret-mode on CPU, so tests exercise the
     production dispatch); bigger aligned boards run the multi-step-fused
-    tiled kernel (one HBM pass per up-to-128 steps); anything else takes
-    the compiled-XLA packed loop (any shape, any backend). ``n`` is a
-    runtime scalar — changing it does not recompile any path.
+    tiled kernel (one HBM pass per up-to-128 steps); bigger UNALIGNED
+    boards take the padded-torus-frame runner (same fused kernels over a
+    word/lane-padded frame, ``bitlife.life_run_frame_bits``); anything
+    left takes the compiled-XLA packed loop (any shape, any backend).
+    ``n`` is a runtime scalar — changing it does not recompile any path.
     """
     from mpi_and_open_mp_tpu.ops import bitlife
 
     if bitlife.fits_vmem_packed(board.shape):
         return bitlife.life_run_vmem_bits(board, n, interpret=_interpret())
-    if not _interpret() and bitlife.fused_bits_supported(board.shape):
+    if not _interpret():
         # Interpret-mode Pallas at big-board sizes is impractical; CPU
-        # takes the XLA loop below (the fused kernel itself is covered in
-        # interpret mode by tests at small aligned shapes).
-        return bitlife.life_run_fused_bits(board, n)
+        # takes the XLA loop below (the fused kernels are covered in
+        # interpret mode by tests at small shapes).
+        if bitlife.fused_bits_supported(board.shape):
+            return bitlife.life_run_fused_bits(board, n)
+        if bitlife.plan_sharded_bits(
+            board.shape, 1, 1, False, False
+        ) is not None:
+            return bitlife.life_run_frame_bits(board, n)
     return bitlife.life_run_bits_xla(board, n)
 
 

@@ -266,3 +266,29 @@ def test_plan_window_small_shards():
     # Hopeless geometry still returns None.
     assert bitlife.plan_sharded_bits((64, 128), 8, 1, True, False) is None
     assert bitlife.plan_sharded_bits((256, 20), 4, 2, True, True) is None
+
+
+@pytest.mark.parametrize("shape,budget,mode,steps", [
+    ((100, 130), bitlife._PACKED_VMEM_LIMIT, "window", 110),
+    ((740, 250), 20_000, "tiled", 140),  # pad_y=28 + nx_exact, multi-tile
+])
+def test_frame_bits_serial_unaligned(shape, budget, mode, steps):
+    """The single-device padded-frame runner: unaligned boards through
+    the fused kernels (local funnel y wrap + wrap-patched x rolls),
+    crossing fused-round boundaries."""
+    plan = bitlife.plan_sharded_bits(shape, 1, 1, False, False, budget)
+    assert plan is not None and plan.mode == mode, plan
+    assert steps > plan.k_max
+    b = _soup(*shape, seed=33)
+    got = np.asarray(bitlife.life_run_frame_bits(
+        jnp.asarray(b), steps, interpret=True, budget=budget))
+    assert np.array_equal(got, _oracle(b, steps))
+
+
+def test_frame_bits_steps_runtime_scalar_no_retrace():
+    b = jnp.asarray(_soup(100, 130))
+    f = bitlife._run_frame_bits_jit
+    bitlife.life_run_frame_bits(b, 2, interpret=True)
+    before = f._cache_size()
+    bitlife.life_run_frame_bits(b, 7, interpret=True)
+    assert f._cache_size() == before
