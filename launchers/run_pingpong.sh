@@ -1,8 +1,15 @@
 #!/usr/bin/env bash
 # Fabric probe at two placements (reference job_single.sh vs job_mult.sh:
-# shared-memory vs NIC transport). Here the two interesting placements are
-# the single-chip loopback and the full mesh over ICI; multi-host pods add
-# a DCN row. Writes out_single.csv / out_mesh.csv for analysis/plot_network.py.
+# shared-memory vs NIC transport). Here the two placements are the
+# single-chip run and the full local mesh over ICI; multi-host pods add a
+# DCN row (launchers/job_pingpong.sh probes the process-boundary analogue).
+#
+# CAVEAT (single-chip hosts): with --devices 1 the "ring" is a
+# self-permute — there is no second ICI endpoint, so the CSV measures the
+# on-device dispatch/copy floor, NOT transport (cf. the committed
+# results/network/out_tpu_loopback.csv provenance note). The reference's
+# shared-memory-vs-NIC contrast needs >=2 real chips; until then the
+# meaningful contrast is job_pingpong.sh's single vs mult placements.
 #
 # Usage: launchers/run_pingpong.sh [--virtual]
 set -euo pipefail
