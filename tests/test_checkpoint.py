@@ -107,3 +107,29 @@ def test_checkpoint_uneven_board(tmp_path, make_board):
     resumed = LifeSim.from_checkpoint(ckpt, cfg, layout="col", impl="roll")
     final = resumed.run(save=False)
     np.testing.assert_array_equal(final, oracle_n(board, 10))
+
+
+def test_checkpoint_resume_bitfused_padded_frame(tmp_path, make_board):
+    """Mid-run checkpoint/resume through the packed path on an unaligned
+    board: the stored state is the PADDED frame (mirror rows included);
+    restore must crop to the logical board, re-pad for the resuming
+    mesh/impl, and continue bit-exact — including resuming onto a
+    DIFFERENT layout's frame geometry."""
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+    board = make_board(100, 130)
+    cfg = config_from_board(board, steps=80, save_steps=0)
+    mesh = mesh_lib.make_mesh_2d(2, 4)
+    sim = LifeSim(cfg, layout="row", impl="bitfused", mesh=mesh)
+    sim.step(45)  # crosses the k_max=32 round boundary before saving
+    ckpt = tmp_path / "bit_ck"
+    sim.save_checkpoint(ckpt)
+
+    for layout, impl in [("row", "bitfused"), ("cart", "bitfused"),
+                         ("col", "roll")]:
+        resumed = LifeSim.from_checkpoint(
+            ckpt, cfg, layout=layout, impl=impl, mesh=mesh)
+        assert resumed.step_count == 45
+        got = resumed.run(save=False)
+        np.testing.assert_array_equal(
+            got, oracle_n(board, 80), err_msg=f"{layout}/{impl}")
