@@ -39,6 +39,34 @@ def main(argv=None) -> int:
         NY = NX = args.board
     if args.steps:
         STEPS = args.steps
+
+    # Backend watchdog: a wedged axon relay (observed after a TPU client
+    # was killed mid-claim) makes jax.devices() hang indefinitely IN THIS
+    # PROCESS too — probe device discovery in a killable subprocess first
+    # and fall back to CPU (honestly labelled) so the bench records a
+    # line instead of hanging the harness.
+    import os
+    import subprocess
+    backend_note = {}
+    try:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
+    except ValueError:
+        probe_timeout = 240.0
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout, check=True, capture_output=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        stderr = (e.stderr or b"").decode(errors="replace").strip()
+        backend_note = {"backend_fallback": (
+            f"device discovery failed/hung ({type(e).__name__}"
+            + (f": ...{stderr[-160:]}" if stderr else "")
+            + "); ran on CPU — not a TPU measurement"
+        )}
     import jax
 
     from mpi_and_open_mp_tpu.models.life import LifeSim
@@ -190,6 +218,7 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
         "impl": sim.impl,
         **sharded,
+        **backend_note,
     }))
     return 0
 
