@@ -10,13 +10,11 @@ it out — but we expose it).
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from mpi_and_open_mp_tpu.ops import quadrature

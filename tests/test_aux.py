@@ -7,8 +7,7 @@ import pytest
 
 from mpi_and_open_mp_tpu.apps import life as life_app
 from mpi_and_open_mp_tpu.models.life import LifeSim
-from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
-from mpi_and_open_mp_tpu.utils.config import config_from_board, load_config_py
+from mpi_and_open_mp_tpu.utils.config import config_from_board
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 

@@ -8,7 +8,6 @@ import numpy as np
 import pytest
 
 from mpi_and_open_mp_tpu.apps import hello as hello_app
-from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
 from mpi_and_open_mp_tpu.utils.config import load_config_py
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -65,7 +65,6 @@ def test_invalid_n():
 
 
 def test_custom_interval():
-    import jax.numpy as jnp
 
     mesh = mesh_lib.make_mesh_1d(8, axis="i")
     val = Integral(100_000, a=0.0, b=1.0, f=lambda x: x * x, mesh=mesh).compute()

@@ -13,7 +13,6 @@ import pytest
 import jax
 
 from mpi_and_open_mp_tpu.models.life import LifeSim
-from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils.config import config_from_board, load_config_py
 from mpi_and_open_mp_tpu.utils.vtk import read_vtk
