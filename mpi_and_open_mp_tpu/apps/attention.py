@@ -28,8 +28,10 @@ def main(argv=None) -> int:
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--causal", action="store_true")
     p.add_argument("--grad", action="store_true",
-                   help="time the backward pass too (rematerialised "
-                   "block updates keep it O(chunk x seq) memory)")
+                   help="time the backward pass too (the chunked path "
+                   "takes the flash custom_vjp backward, O(seq*d) "
+                   "residuals; the multi-device ring remats its block "
+                   "updates)")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="GQA/MQA: fewer K/V heads than query heads")
     p.add_argument("--devices", type=int, default=None,

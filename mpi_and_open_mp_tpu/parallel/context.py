@@ -77,22 +77,44 @@ def attention_reference(
 _Q_CHUNK = 512
 
 
-@jax.checkpoint
-def _block_update(q32, k, v, mask, o, m, l):
+def _mask_from_pos(qpos, kpos, n: int | None, causal: bool):
+    """Boolean ``(nq, nk)`` allow-mask from position vectors: ``kpos < n``
+    validity (padding) when ``n`` is given, causality when ``causal`` —
+    or None when everything is allowed."""
+    valid = None
+    if n is not None:
+        valid = kpos[None, :] < n
+    if causal:
+        c = qpos[:, None] >= kpos[None, :]
+        valid = c if valid is None else valid & c
+    return valid
+
+
+@functools.partial(jax.checkpoint, static_argnums=(5, 6))
+def _block_update(q32, k, v, qpos, kpos, n, causal, o, m, l):
     """One online-softmax accumulation of a K/V block into (o, m, l).
 
-    ``mask`` is boolean ``(hq, nq, nk)`` (or None = all allowed). Running
-    state: ``o`` (hq, nq, d) unnormalised output, ``m`` (hq, nq) running max,
-    ``l`` (hq, nq) running denominator — all float32.
+    The allow-mask is built INSIDE from the ``qpos``/``kpos`` position
+    vectors (``n`` = valid k length for padding, or None; ``causal``
+    static). Running state: ``o`` (hq, nq, d) unnormalised output, ``m``
+    (hq, nq) running max, ``l`` (hq, nq) running denominator — all
+    float32.
 
     Rematerialised (``jax.checkpoint``): reverse-mode would otherwise
     store every block's softmax weights — O(seq²) residuals across the
     scan/ring — where recomputing them in the backward pass keeps
     training-style gradients O(chunk x seq) like the forward (the flash
     attention backward trick). Measured: a causal 16k-token backward on
-    one chip OOMs HBM without this and runs with it.
+    one chip OOMs HBM without this and runs with it. Building the mask
+    in here (rather than passing it) matters for the same reason: a
+    passed mask is a checkpoint residual — O(hq·nq·nk) bools per block
+    stacked across the ring/scan — where the position vectors are O(n).
+    (The local chunked path doesn't rely on this — it has a real flash
+    backward, ``_flash_chunked_bwd``; this remat path carries the
+    multi-device ring backward.)
     """
     d = q32.shape[-1]
+    mask = _mask_from_pos(qpos, kpos, n, causal)
     s = jnp.einsum(
         "hqd,hkd->hqk", q32, k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
@@ -155,33 +177,20 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
             # hkv-head blocks.
             kb, vb = _repeat_heads(kb, vb, groups)
             if not chunked:
-                if causal:
-                    qpos = idx * nl + jnp.arange(nl)
-                    mask = jnp.broadcast_to(
-                        qpos[:, None] >= kpos[None, :], (h, nl, nl))
-                else:
-                    mask = None
-                return _block_update(q32, kb, vb, mask, o, m, l)
+                qpos = idx * nl + jnp.arange(nl)
+                return _block_update(q32, kb, vb, qpos, kpos, None, causal,
+                                     o, m, l)
             # Scan q (and its running state) in (h, _Q_CHUNK) slices so
             # only a (h, _Q_CHUNK, nl) score block is ever live.
 
             def to_chunks(x):
-                return x.reshape(
-                    h, nc, _Q_CHUNK, *x.shape[2:]).swapaxes(0, 1)
-
-            def from_chunks(x):
-                y = x.swapaxes(0, 1)
-                return y.reshape(h, nlp, *y.shape[3:])
+                return _chunk(x, nc, _Q_CHUNK)
 
             def body(_, xs):
                 qc, oc, mc, lc, ci = xs
-                if causal:
-                    qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(_Q_CHUNK)
-                    mask = jnp.broadcast_to(
-                        qpos[:, None] >= kpos[None, :], (h, _Q_CHUNK, nl))
-                else:
-                    mask = None
-                oc, mc, lc = _block_update(qc, kb, vb, mask, oc, mc, lc)
+                qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(_Q_CHUNK)
+                oc, mc, lc = _block_update(qc, kb, vb, qpos, kpos, None,
+                                           causal, oc, mc, lc)
                 return None, (oc, mc, lc)
 
             _, (os_, ms, ls) = lax.scan(
@@ -189,7 +198,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
                 (to_chunks(q32), to_chunks(o), to_chunks(m), to_chunks(l),
                  jnp.arange(nc)),
             )
-            return from_chunks(os_), from_chunks(ms), from_chunks(ls)
+            return _unchunk(os_), _unchunk(ms), _unchunk(ls)
 
         if not causal:
             return compute((kb, vb, o, m, l))
@@ -241,19 +250,57 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
     lengths are padded — padded k positions are masked out, padded q rows
     are computed and discarded — so there is no divisibility cliff. Used
     by the Ulysses path and by single-device rings.
+
+    Differentiation takes the flash-attention backward (``custom_vjp``
+    below), NOT autodiff through the scans: reverse-mode of the chunked
+    forward saves O(seq²) block residuals even under remat (measured: a
+    causal 16k backward OOMs 16 GB HBM, and 8k runs 15x slower than its
+    forward), where the flash backward stores only ``(q, k, v, o,
+    logsumexp)`` — O(seq·d) — and recomputes each score block from the
+    saved row statistics.
+
+    Caveat (measured, JAX 0.8): differentiating THROUGH a ``lax.scan``
+    whose body calls this function (e.g. scanning attention layers and
+    grad-ing the whole stack) defeats the memory bound — scan
+    linearisation stacks per-block forward intermediates across
+    iterations even though the custom backward is still the one invoked.
+    Unroll such chains (python loop) or keep ``jax.grad`` inside the scan
+    body; ``tests/test_context.py::test_flash_backward_residuals_bounded``
+    pins the unrolled behaviour.
     """
     h, n, d = q.shape
     if n <= _Q_CHUNK:
         return attention_reference(q, k, v, causal=causal)
+    return _flash_chunked(causal, q, k, v)
+
+
+def _chunk(x, nc: int, c: int):
+    """(h, nc*c, d...) -> (nc, h, c, d...) scan-leading chunk view."""
+    h = x.shape[0]
+    return x.reshape(h, nc, c, *x.shape[2:]).swapaxes(0, 1)
+
+
+def _unchunk(x):
+    h, c = x.shape[1], x.shape[2]
+    y = x.swapaxes(0, 1)
+    return y.reshape(h, x.shape[0] * c, *x.shape[3:])
+
+
+def _flash_forward(causal: bool, q, k, v):
+    """Chunked forward returning ``(o, L)``: the attention output and the
+    per-row logsumexp ``L = m + log l`` of the *scaled* scores — the only
+    row statistic the flash backward needs to recompute any block's
+    normalised probabilities as ``exp(s - L)``. Padded/fully-masked rows
+    get ``L = -_NEG`` (huge) so recomputed probabilities underflow to 0.
+    """
+    h, n, d = q.shape
     c = _Q_CHUNK
     nc = -(-n // c)
     pad = nc * c - n
     q32 = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, pad), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
-    qs = q32.reshape(h, nc, c, d).swapaxes(0, 1)
-    ks = kp.reshape(h, nc, c, d).swapaxes(0, 1)
-    vs = vp.reshape(h, nc, c, d).swapaxes(0, 1)
+    qs, ks, vs = _chunk(q32, nc, c), _chunk(kp, nc, c), _chunk(vp, nc, c)
 
     def body_q(_, xs):
         qc, ci = xs
@@ -263,13 +310,11 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
             oc, mc, lc = carry
             kb, vb, kj = ys
             kpos = kj * c + jnp.arange(c)
-            valid = kpos[None, :] < n
-            if causal:
-                valid = valid & (qpos[:, None] >= kpos[None, :])
-            mask = jnp.broadcast_to(valid, (h, c, c))
+            n_valid = n if pad else None  # padded k tail needs masking
 
             def upd(args):
-                return _block_update(qc, args[0], args[1], mask,
+                return _block_update(qc, args[0], args[1], qpos, kpos,
+                                     n_valid, causal,
                                      args[2], args[3], args[4])
 
             if causal:
@@ -286,14 +331,127 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
         o0 = jnp.zeros((h, c, d), jnp.float32)
         m0 = jnp.full((h, c), _NEG, jnp.float32)
         l0 = jnp.zeros((h, c), jnp.float32)
-        (oc, _, lc), _ = lax.scan(
+        (oc, mc, lc), _ = lax.scan(
             body_k, (o0, m0, l0), (ks, vs, jnp.arange(nc)))
+        Lc = jnp.where(lc > 0, mc + jnp.log(jnp.maximum(lc, 1e-37)), -_NEG)
         oc = oc / jnp.where(lc > 0, lc, 1.0)[..., None]
-        return None, oc
+        return None, (oc, Lc)
 
-    _, os_ = lax.scan(body_q, None, (qs, jnp.arange(nc)))
-    out = os_.swapaxes(0, 1).reshape(h, nc * c, d)[:, :n, :]
-    return out.astype(q.dtype)
+    _, (os_, Ls) = lax.scan(body_q, None, (qs, jnp.arange(nc)))
+    return _unchunk(os_)[:, :n, :].astype(q.dtype), _unchunk(Ls)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_chunked(causal: bool, q, k, v):
+    return _flash_forward(causal, q, k, v)[0]
+
+
+def _flash_chunked_fwd(causal: bool, q, k, v):
+    o, L = _flash_forward(causal, q, k, v)
+    return o, (q, k, v, o, L)
+
+
+def _flash_chunked_bwd(causal: bool, res, do):
+    """Flash-attention backward: recompute each block's probabilities from
+    the saved logsumexp, two chunk-parallel passes (one producing dq, one
+    dk+dv — each a clean scan with no cross-chunk accumulation), causal
+    block skipping mirrored from the forward. Per block:
+
+        p  = exp(s - L)            (recomputed, masked)
+        D  = rowsum(do * o)
+        dv = pᵀ do
+        dq = scale · [p∘(do vᵀ - D)] k ;  dk = scale · [...]ᵀ q
+    """
+    q, k, v, o, L = res
+    h, n, d = q.shape
+    c = _Q_CHUNK
+    nc = -(-n // c)
+    pad = nc * c - n
+    scale = 1.0 / math.sqrt(d)
+    f32 = jnp.float32
+
+    def padded(x, fill=0.0):
+        return jnp.pad(x.astype(f32), ((0, 0), (0, pad), (0, 0)),
+                       constant_values=fill)
+
+    q32, k32, v32 = padded(q), padded(k), padded(v)
+    do32, o32 = padded(do), padded(o)
+    Lp = L  # already padded to nc*c by the forward (pad rows = -_NEG)
+    D = jnp.sum(do32 * o32, axis=-1)  # (h, nc*c)
+    qs, ks, vs = (_chunk(x, nc, c) for x in (q32, k32, v32))
+    dos = _chunk(do32, nc, c)
+    Ls, Ds = _chunk(Lp, nc, c), _chunk(D, nc, c)
+    ar = jnp.arange(c)
+
+    def probs(qc, kb, Lc, ci, kj):
+        s = jnp.einsum("hqd,hkd->hqk", qc, kb,
+                       preferred_element_type=f32) * scale
+        mask = _mask_from_pos(ci * c + ar, kj * c + ar, n, causal)
+        return jnp.where(mask, jnp.exp(s - Lc[..., None]), 0.0)
+
+    def body_dq(_, xs):
+        qc, doc, Lc, Dc, ci = xs
+
+        def body_k(dqc, ys):
+            kb, vb, kj = ys
+
+            def upd(dqc):
+                p = probs(qc, kb, Lc, ci, kj)
+                dp = jnp.einsum("hqd,hkd->hqk", doc, vb,
+                                preferred_element_type=f32)
+                t = p * (dp - Dc[..., None])
+                return dqc + scale * jnp.einsum(
+                    "hqk,hkd->hqd", t, kb, preferred_element_type=f32)
+
+            if causal:
+                dqc = lax.cond(kj <= ci, upd, lambda x: x, dqc)
+            else:
+                dqc = upd(dqc)
+            return dqc, None
+
+        dqc, _ = lax.scan(body_k, jnp.zeros((h, c, d), f32),
+                          (ks, vs, jnp.arange(nc)))
+        return None, dqc
+
+    _, dqs = lax.scan(body_dq, None, (qs, dos, Ls, Ds, jnp.arange(nc)))
+
+    def body_dkv(_, ys):
+        kb, vb, kj = ys
+
+        def body_q(carry, xs):
+            qc, doc, Lc, Dc, ci = xs
+
+            def upd(carry):
+                dkc, dvc = carry
+                p = probs(qc, kb, Lc, ci, kj)
+                dvc = dvc + jnp.einsum("hqk,hqd->hkd", p, doc,
+                                       preferred_element_type=f32)
+                dp = jnp.einsum("hqd,hkd->hqk", doc, vb,
+                                preferred_element_type=f32)
+                t = p * (dp - Dc[..., None])
+                dkc = dkc + scale * jnp.einsum(
+                    "hqk,hqd->hkd", t, qc, preferred_element_type=f32)
+                return dkc, dvc
+
+            if causal:
+                carry = lax.cond(ci >= kj, upd, lambda x: x, carry)
+            else:
+                carry = upd(carry)
+            return carry, None
+
+        z = jnp.zeros((h, c, d), f32)
+        (dkc, dvc), _ = lax.scan(
+            body_q, (z, z), (qs, dos, Ls, Ds, jnp.arange(nc)))
+        return None, (dkc, dvc)
+
+    _, (dks, dvs) = lax.scan(body_dkv, None, (ks, vs, jnp.arange(nc)))
+    dq = _unchunk(dqs)[:, :n, :].astype(q.dtype)
+    dk = _unchunk(dks)[:, :n, :].astype(k.dtype)
+    dv = _unchunk(dvs)[:, :n, :].astype(v.dtype)
+    return dq, dk, dv
+
+
+_flash_chunked.defvjp(_flash_chunked_fwd, _flash_chunked_bwd)
 
 
 def _seq_spec(axis: str) -> P:
