@@ -1,0 +1,99 @@
+"""Render the big-board scaling sweep (results/life/bigboard_tpu.csv).
+
+One series — steady-state Gcups vs board edge on one chip — with each
+point colored by the native path the serial dispatcher picked (VMEM-
+resident / fused tiled / padded frame), log-x. The committed-PNG analog
+of the reference's `plot_life.py` speedup rendering, for the board-size
+scaling axis (SURVEY §7 step 8).
+
+Colors are the first three slots of the repo's validated categorical
+palette (documented all-pairs pass, light mode); identity is also carried
+by direct labels, never color alone.
+
+Usage: python analysis/plot_bigboard.py [csv] [out.png]
+"""
+
+from __future__ import annotations
+
+import csv
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+SURFACE = "#fcfcfb"
+TEXT = "#0b0b0b"
+TEXT_2 = "#52514e"
+GRID = "#e5e4e0"
+PATH_COLOR = {  # categorical slots 1-3 (validated all-pairs, light)
+    "vmem": "#2a78d6",
+    "fused": "#eb6834",
+    "frame": "#1baf7a",
+    "xla": "#52514e",
+}
+PATH_LABEL = {
+    "vmem": "VMEM-resident loop",
+    "fused": "fused tiled kernel",
+    "frame": "padded torus frame",
+    "xla": "XLA packed loop",
+}
+
+
+def main(argv) -> int:
+    src = argv[1] if len(argv) > 1 else "results/life/bigboard_tpu.csv"
+    out = argv[2] if len(argv) > 2 else "results/life/bigboard_tpu.png"
+    with open(src) as f:
+        rows = list(csv.DictReader(f))
+    ns = [int(r["n"]) for r in rows]
+    gc = [float(r["steady_gcups"]) for r in rows]
+    paths = [r["path"] for r in rows]
+
+    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    ax.set_facecolor(SURFACE)
+    ax.plot(ns, gc, color=TEXT_2, lw=1.2, zorder=1, alpha=0.5)
+    seen = []
+    for n, g, p in zip(ns, gc, paths):
+        lbl = PATH_LABEL[p] if p not in seen else None
+        seen.append(p)
+        ax.scatter([n], [g], s=52, color=PATH_COLOR[p], label=lbl,
+                   zorder=3, edgecolors=SURFACE, linewidths=1.5)
+    for n, g, txt in [
+        (ns[0], gc[0], f"{ns[0]}² flagship\n{gc[0]:.0f}"),
+        (1024, dict(zip(ns, gc)).get(1024, gc[1]), "peak "
+         f"{max(gc):.0f} Gcups"),
+        (10000, dict(zip(ns, gc)).get(10000, 0), "10000² (unaligned)"),
+    ]:
+        if g:
+            ax.annotate(txt, (n, g), textcoords="offset points",
+                        xytext=(6, -14), fontsize=7.5, color=TEXT_2)
+    ax.set_xscale("log")
+    ax.set_xticks(ns, [str(n) for n in ns], rotation=45, fontsize=8)
+    ax.set_xticks([], minor=True)
+    ax.set_ylim(0, max(gc) * 1.15)
+    ax.set_xlabel("board edge (cells)", color=TEXT, fontsize=9)
+    ax.set_ylabel("steady-state Gcups (one chip)", color=TEXT, fontsize=9)
+    ax.set_title(
+        "Game-of-Life board-size scaling, single TPU chip\n"
+        "(differenced steady-state; MPI cluster best = 1.29 Gcups @ 27 ranks)",
+        color=TEXT, fontsize=9.5,
+    )
+    ax.grid(axis="y", color=GRID, lw=0.7, zorder=0)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=TEXT_2, labelsize=8)
+    leg = ax.legend(loc="lower right", fontsize=8, frameon=False)
+    for t in leg.get_texts():
+        t.set_color(TEXT)
+    fig.tight_layout()
+    fig.savefig(out, facecolor=SURFACE)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

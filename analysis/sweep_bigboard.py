@@ -1,0 +1,125 @@
+"""Big-board Life scaling sweep on the real chip (SURVEY §7 step 8).
+
+The reference's scaling study stops at its 500x500 flagship
+(`3-life/p46gun_big.cfg`) swept over MPI ranks; the TPU build's scale-up
+axis is BOARD size on one chip — each size exercises whichever native
+path the serial dispatcher (`ops.pallas_life.life_run_vmem`) picks:
+VMEM-resident packed loop, multi-step-fused tiled kernel, padded-torus
+frame (unaligned), or the compiled-XLA packed loop.
+
+Per size: steady-state cell-updates/sec by the same RTT-cancelling
+differencing discipline as `bench.py` (time S and 3S steps through the
+SAME compiled executable — the step count is a runtime scalar — and
+difference), best-of-3 each. Emits a CSV:
+
+    n,steps,path,steady_us_per_step,steady_gcups,differenced
+
+Usage:  python analysis/sweep_bigboard.py [--out results/life/bigboard_tpu.csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def dispatch_path(shape: tuple[int, int]) -> str:
+    """Which native path `life_run_vmem` takes for `shape` (TPU backend)."""
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    if bitlife.fits_vmem_packed(shape):
+        return "vmem"
+    if bitlife.fused_bits_supported(shape):
+        return "fused"
+    if bitlife.plan_sharded_bits(shape, 1, 1, False, False) is not None:
+        return "frame"
+    return "xla"
+
+
+def measure(n: int, steps: int) -> tuple[float, bool]:
+    """Steady seconds/step for an n x n board, and whether differenced."""
+    import jax
+
+    from mpi_and_open_mp_tpu.ops.pallas_life import life_run_vmem
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    rng = np.random.default_rng(46)
+    board = jax.device_put(
+        (rng.random((n, n)) < 0.3).astype(np.uint8)
+    )
+    anchor_sync(life_run_vmem(board, steps), fetch_all=True)  # compile
+    anchor_sync(life_run_vmem(board, 3 * steps), fetch_all=True)
+
+    def timed(s: int) -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            anchor_sync(life_run_vmem(board, s), fetch_all=True)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t3 = timed(steps), timed(3 * steps)
+    if t3 > t1:
+        return (t3 - t1) / (2 * steps), True
+    return t1 / steps, False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="results/life/bigboard_tpu.csv")
+    ap.add_argument(
+        "--sizes", type=int, nargs="+",
+        # 500 = flagship; 3072 = last VMEM-resident size; 10000 = unaligned
+        # (ny % 32 != 0) so it takes the padded-frame path; the rest fused.
+        default=[500, 1024, 2048, 3072, 4096, 8192, 10000, 16384],
+    )
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print("refusing to record: backend is not TPU", file=sys.stderr)
+        return 1
+
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.ops.pallas_life import life_run_vmem
+
+    # Honesty gate (same as bench.py): the dispatcher must be bit-exact
+    # vs the host oracle before any of its timings are recorded.
+    rng = np.random.default_rng(46)
+    small = (rng.random((500, 500)) < 0.3).astype(np.uint8)
+    got = np.asarray(jax.device_get(life_run_vmem(jax.device_put(small), 8)))
+    ref = small.copy()
+    for _ in range(8):
+        ref = life_step_numpy(ref)
+    if not np.array_equal(got, ref):
+        print("parity check failed; not recording", file=sys.stderr)
+        return 1
+
+    rows = ["n,steps,path,steady_us_per_step,steady_gcups,differenced"]
+    for n in args.sizes:
+        # Aim ~0.5 s of steady compute per base run (floor 100 steps so
+        # the fused paths cross several 128-step rounds).
+        steps = max(100, min(2_000_000, int(7e11 / (n * n))))
+        sec, diff = measure(n, steps)
+        gcups = n * n / sec / 1e9
+        rows.append(
+            f"{n},{steps},{dispatch_path((n, n))},"
+            f"{sec * 1e6:.3f},{gcups:.1f},{int(diff)}"
+        )
+        print(rows[-1], flush=True)
+
+    with open(args.out, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
