@@ -40,14 +40,15 @@ def main(argv) -> int:
     ax.set_facecolor(SURFACE)
     ax.plot(seqs, fwd, color=C_FWD, lw=2, marker="o", ms=7,
             markeredgecolor=SURFACE, markeredgewidth=1.5, label="forward")
-    ax.plot([s for s, _ in bwd], [t for _, t in bwd], color=C_BWD, lw=2,
-            marker="o", ms=7, markeredgecolor=SURFACE, markeredgewidth=1.5,
-            label="backward (flash custom_vjp)")
     ax.annotate("forward", (seqs[-1], fwd[-1]), textcoords="offset points",
                 xytext=(-8, 10), fontsize=8, color=TEXT_2, ha="right")
-    ax.annotate("backward", (bwd[-1][0], bwd[-1][1]),
-                textcoords="offset points", xytext=(-8, -16), fontsize=8,
-                color=TEXT_2, ha="right")
+    if bwd:
+        ax.plot([s for s, _ in bwd], [t for _, t in bwd], color=C_BWD,
+                lw=2, marker="o", ms=7, markeredgecolor=SURFACE,
+                markeredgewidth=1.5, label="backward (flash custom_vjp)")
+        ax.annotate("backward", (bwd[-1][0], bwd[-1][1]),
+                    textcoords="offset points", xytext=(-8, -16),
+                    fontsize=8, color=TEXT_2, ha="right")
     ax.set_xscale("log")
     ax.set_xticks(seqs, [f"{s // 1024}k" for s in seqs], fontsize=8)
     ax.set_xticks([], minor=True)
@@ -66,9 +67,10 @@ def main(argv) -> int:
     for s in ("left", "bottom"):
         ax.spines[s].set_color(GRID)
     ax.tick_params(colors=TEXT_2, labelsize=8)
-    leg = ax.legend(loc="lower right", fontsize=8, frameon=False)
-    for t in leg.get_texts():
-        t.set_color(TEXT)
+    if bwd:  # single-series charts carry no legend box (title names it)
+        leg = ax.legend(loc="lower right", fontsize=8, frameon=False)
+        for t in leg.get_texts():
+            t.set_color(TEXT)
     fig.tight_layout()
     fig.savefig(out, facecolor=SURFACE)
     print(f"wrote {out}")

@@ -60,15 +60,14 @@ def main(argv) -> int:
         seen.append(p)
         ax.scatter([n], [g], s=52, color=PATH_COLOR[p], label=lbl,
                    zorder=3, edgecolors=SURFACE, linewidths=1.5)
-    for n, g, txt in [
-        (ns[0], gc[0], f"{ns[0]}² flagship\n{gc[0]:.0f}"),
-        (1024, dict(zip(ns, gc)).get(1024, gc[1]), "peak "
-         f"{max(gc):.0f} Gcups"),
-        (10000, dict(zip(ns, gc)).get(10000, 0), "10000² (unaligned)"),
-    ]:
-        if g:
-            ax.annotate(txt, (n, g), textcoords="offset points",
-                        xytext=(6, -14), fontsize=7.5, color=TEXT_2)
+    peak = max(range(len(gc)), key=gc.__getitem__)
+    notes = [(ns[0], gc[0], f"{ns[0]}² flagship\n{gc[0]:.0f}"),
+             (ns[peak], gc[peak], f"peak {gc[peak]:.0f} Gcups")]
+    notes += [(n, g, f"{n}² (unaligned)")
+              for n, g, p in zip(ns, gc, paths) if p == "frame"]
+    for n, g, txt in notes:
+        ax.annotate(txt, (n, g), textcoords="offset points",
+                    xytext=(6, -14), fontsize=7.5, color=TEXT_2)
     ax.set_xscale("log")
     ax.set_xticks(ns, [str(n) for n in ns], rotation=45, fontsize=8)
     ax.set_xticks([], minor=True)

@@ -29,19 +29,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def dispatch_path(shape: tuple[int, int]) -> str:
-    """Which native path `life_run_vmem` takes for `shape` (TPU backend)."""
-    from mpi_and_open_mp_tpu.ops import bitlife
-
-    if bitlife.fits_vmem_packed(shape):
-        return "vmem"
-    if bitlife.fused_bits_supported(shape):
-        return "fused"
-    if bitlife.plan_sharded_bits(shape, 1, 1, False, False) is not None:
-        return "frame"
-    return "xla"
-
-
 def measure(n: int, steps: int) -> tuple[float, bool]:
     """Steady seconds/step for an n x n board, and whether differenced."""
     import jax
@@ -102,6 +89,8 @@ def main(argv=None) -> int:
         print("parity check failed; not recording", file=sys.stderr)
         return 1
 
+    from mpi_and_open_mp_tpu.ops.pallas_life import native_path
+
     rows = ["n,steps,path,steady_us_per_step,steady_gcups,differenced"]
     for n in args.sizes:
         # Aim ~0.5 s of steady compute per base run (floor 100 steps so
@@ -110,11 +99,14 @@ def main(argv=None) -> int:
         sec, diff = measure(n, steps)
         gcups = n * n / sec / 1e9
         rows.append(
-            f"{n},{steps},{dispatch_path((n, n))},"
+            f"{n},{steps},{native_path((n, n))},"
             f"{sec * 1e6:.3f},{gcups:.1f},{int(diff)}"
         )
         print(rows[-1], flush=True)
 
+    outdir = os.path.dirname(args.out)
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
     with open(args.out, "w") as f:
         f.write("\n".join(rows) + "\n")
     print(f"wrote {args.out}", file=sys.stderr)

@@ -46,6 +46,28 @@ def fits_vmem(shape: tuple[int, int]) -> bool:
     return ny * nx * 4 <= _VMEM_BYTES_LIMIT
 
 
+def native_path(shape: tuple[int, int], on_tpu: bool = True) -> str:
+    """Which native path :func:`life_run_vmem` dispatches ``shape`` to:
+    ``"vmem"`` (whole-board VMEM-resident packed loop), ``"fused"``
+    (multi-step-fused tiled kernel), ``"frame"`` (padded-torus-frame
+    runner for unaligned big boards), or ``"xla"`` (compiled-XLA packed
+    loop). The single source of truth for the dispatch decision — the
+    recorded-results sweeps label their rows with this."""
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    if bitlife.fits_vmem_packed(shape):
+        return "vmem"
+    if on_tpu:
+        # Interpret-mode Pallas at big-board sizes is impractical; CPU
+        # takes the XLA loop (the fused kernels are covered in interpret
+        # mode by tests at small shapes).
+        if bitlife.fused_bits_supported(shape):
+            return "fused"
+        if bitlife.plan_sharded_bits(shape, 1, 1, False, False) is not None:
+            return "frame"
+    return "xla"
+
+
 def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     """Advance ``n`` steps on one device, picking the fastest native path.
 
@@ -61,18 +83,13 @@ def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     """
     from mpi_and_open_mp_tpu.ops import bitlife
 
-    if bitlife.fits_vmem_packed(board.shape):
+    path = native_path(board.shape, on_tpu=not _interpret())
+    if path == "vmem":
         return bitlife.life_run_vmem_bits(board, n, interpret=_interpret())
-    if not _interpret():
-        # Interpret-mode Pallas at big-board sizes is impractical; CPU
-        # takes the XLA loop below (the fused kernels are covered in
-        # interpret mode by tests at small shapes).
-        if bitlife.fused_bits_supported(board.shape):
-            return bitlife.life_run_fused_bits(board, n)
-        if bitlife.plan_sharded_bits(
-            board.shape, 1, 1, False, False
-        ) is not None:
-            return bitlife.life_run_frame_bits(board, n)
+    if path == "fused":
+        return bitlife.life_run_fused_bits(board, n)
+    if path == "frame":
+        return bitlife.life_run_frame_bits(board, n)
     return bitlife.life_run_bits_xla(board, n)
 
 
