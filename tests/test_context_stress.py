@@ -1,0 +1,123 @@
+"""Randomised cross-configuration parity sweep for the attention layer.
+
+The attention analogue of tests/test_stress.py: deterministic (seeded)
+sampling over variant (ring / ulysses / local-chunked), mesh size, head
+count with GQA/MQA kv-head divisors, sequence length (chunk-crossing and
+non-multiple), head dim, causality, dtype, and forward-vs-gradient —
+every sample checked against the dense single-device oracle (gradients
+against autodiff of the oracle). A meta-test pins the sampled coverage
+so a sampler edit can't silently drop a variant, the flash backward, or
+the GQA path from the sweep.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
+from mpi_and_open_mp_tpu.parallel.context import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
+
+N_CASES = 16
+_CHUNK = 16  # shrunk _Q_CHUNK so chunked paths engage at test sizes
+
+
+def _sample(rng):
+    variant = str(rng.choice(["ring", "ulysses", "local"]))
+    p = int(rng.choice([1, 2, 4, 8])) if variant != "local" else 1
+    hkv = int(rng.choice([1, 2, 4]))
+    groups = int(rng.choice([1, 2, 4]))
+    h = hkv * groups
+    if variant == "ulysses" and h % p:
+        p = 1
+    # n: a chunk-crossing multiple of p, sometimes NOT a chunk multiple.
+    base = int(rng.integers(2, 9)) * max(p, 1) * 8
+    n = base + (int(rng.integers(1, 8)) * p if rng.random() < 0.4 else 0)
+    d = int(rng.choice([4, 8, 16]))
+    causal = bool(rng.random() < 0.6)
+    dtype = str(rng.choice(["float32", "float32", "bfloat16"]))
+    grad = bool(rng.random() < 0.35) and dtype == "float32"
+    return variant, p, h, hkv, n, d, causal, dtype, grad
+
+
+def _cases():
+    return [_sample(np.random.default_rng(46_100 + i)) for i in range(N_CASES)]
+
+
+def test_sweep_covers_the_space():
+    cases = _cases()
+    variants = {c[0] for c in cases}
+    assert variants == {"ring", "ulysses", "local"}, variants
+    assert any(c[1] >= 4 for c in cases), "no multi-device mesh sampled"
+    assert any(c[3] < c[2] for c in cases), "no GQA case sampled"
+    assert any(c[8] for c in cases), "no gradient case sampled"
+    assert any(c[4] % _CHUNK for c in cases), "no non-multiple length"
+    assert any(c[7] == "bfloat16" for c in cases), "no bf16 case"
+    # The flash custom_vjp engages when a gradient case's local sequence
+    # exceeds the (shrunk) chunk: ulysses/local see the full n.
+    assert any(c[8] and c[0] in ("ulysses", "local") and c[4] > _CHUNK
+               for c in cases), "no flash-backward case sampled"
+
+
+@pytest.fixture(autouse=True)
+def _small_chunk(monkeypatch):
+    monkeypatch.setattr(context, "_Q_CHUNK", _CHUNK)
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
+
+@pytest.mark.parametrize("case", range(N_CASES))
+def test_random_attention_parity(case, rng):
+    variant, p, h, hkv, n, d, causal, dtype, grad = _sample(
+        np.random.default_rng(46_100 + case))
+    dt = jnp.dtype(dtype)
+    q = jnp.asarray(rng.standard_normal((h, n, d)), dt)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), dt)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), dt)
+    kr = jnp.repeat(k, h // hkv, axis=0).astype(jnp.float32)
+    vr = jnp.repeat(v, h // hkv, axis=0).astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+
+    if variant == "local":
+        def fn(q_, k_, v_):
+            kk = jnp.repeat(k_, h // hkv, axis=0)
+            vv = jnp.repeat(v_, h // hkv, axis=0)
+            return context._attention_chunked(q_, kk, vv, causal)
+    else:
+        mesh = mesh_lib.make_mesh_1d(p, axis="sp")
+        impl = ring_attention if variant == "ring" else ulysses_attention
+
+        def fn(q_, k_, v_):
+            return impl(q_, k_, v_, mesh=mesh, causal=causal)
+
+    tag = (f"{variant} p={p} h={h}/{hkv} n={n} d={d} causal={causal} "
+           f"{dtype} grad={grad}")
+    tol = 1e-4 if dtype == "float32" else 5e-2
+    want = attention_reference(q32, kr, vr, causal=causal)
+    got = np.asarray(fn(q, k, v), dtype=np.float32)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=tol, atol=tol,
+                               err_msg=tag)
+
+    if grad:
+        def loss(f, q_, k_, v_):
+            return jnp.sum(f(q_, k_, v_).astype(jnp.float32) ** 2)
+
+        g_got = jax.grad(lambda *a: loss(fn, *a), argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(
+            lambda q_, k_, v_: loss(
+                lambda a, b, c: attention_reference(
+                    a, jnp.repeat(b, h // hkv, axis=0),
+                    jnp.repeat(c, h // hkv, axis=0), causal=causal),
+                q_, k_, v_),
+            argnums=(0, 1, 2))(q32, k.astype(jnp.float32),
+                               v.astype(jnp.float32))
+        for gg, gw, name in zip(g_got, g_want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gg, dtype=np.float32), np.asarray(gw),
+                rtol=1e-3, atol=1e-3, err_msg=f"{tag} d{name}")
