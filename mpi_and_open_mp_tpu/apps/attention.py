@@ -87,9 +87,14 @@ def main(argv=None) -> int:
            else result)
 
     if not args.no_check:
+        # The dense oracle wants one K/V head per query head — expand
+        # GQA/MQA heads explicitly (the variants keep them un-expanded).
+        groups = args.heads // hkv
         want = context.attention_reference(
-            q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), causal=args.causal)
+            q.astype(jnp.float32),
+            jnp.repeat(k.astype(jnp.float32), groups, axis=0),
+            jnp.repeat(v.astype(jnp.float32), groups, axis=0),
+            causal=args.causal)
         # On TPU, XLA's default matmul precision feeds the MXU bf16 even
         # for f32 operands, so differently-ordered reductions legitimately
         # diverge at the ~1e-3 level; only CPU f32 gets the tight bound.

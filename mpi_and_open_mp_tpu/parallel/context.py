@@ -147,7 +147,8 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     if p == 1:
         # A 1-device ring is just full local attention; the doubly-chunked
         # local path additionally skips future k blocks under causal.
-        return _attention_chunked(q, *_repeat_heads(k, v, groups), causal)
+        # GQA stays un-expanded: the flash path folds query groups.
+        return _attention_chunked(q, k, v, causal)
     idx = lax.axis_index(axis)
     h, nl, d = q.shape
     q32 = q.astype(jnp.float32)
@@ -248,8 +249,11 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
     chunk's future are skipped via ``cond`` (halving the long-context
     FLOPs, like the ring path's hop skipping). Non-multiple sequence
     lengths are padded — padded k positions are masked out, padded q rows
-    are computed and discarded — so there is no divisibility cliff. Used
-    by the Ulysses path and by single-device rings.
+    are computed and discarded — so there is no divisibility cliff.
+    GQA/MQA K/V (fewer heads dividing q's) run UN-expanded: query groups
+    are folded into the row axis (:func:`_fold_groups`) so no repeated
+    K/V is ever materialised and dk/dv come out group-summed. Used by
+    the Ulysses path and by single-device rings.
 
     Differentiation takes the flash-attention backward (``custom_vjp``
     below), NOT autodiff through the scans: reverse-mode of the chunked
@@ -270,7 +274,8 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
     """
     h, n, d = q.shape
     if n <= _Q_CHUNK:
-        return attention_reference(q, k, v, causal=causal)
+        return attention_reference(
+            q, *_repeat_heads(k, v, h // k.shape[0]), causal=causal)
     return _flash_chunked(causal, q, k, v)
 
 
@@ -286,25 +291,55 @@ def _unchunk(x):
     return y.reshape(h, x.shape[0] * c, *x.shape[3:])
 
 
+def _fold_groups(x, hkv: int, g: int):
+    """(hkv*g, n, d...) -> (hkv, n*g, d...): GQA query heads folded into
+    the row axis, g group-rows per position, so every flash einsum runs
+    directly against the UN-expanded (hkv, ...) K/V — no ``jnp.repeat``
+    materialisation, and dk/dv come out group-summed for free. Row ``r``
+    of the folded array holds position ``r // g``."""
+    if g == 1:
+        return x
+    n = x.shape[1]
+    return x.reshape(hkv, g, n, *x.shape[2:]).swapaxes(1, 2).reshape(
+        hkv, n * g, *x.shape[2:])
+
+
+def _unfold_groups(x, hkv: int, g: int):
+    if g == 1:
+        return x
+    ng = x.shape[1]
+    return x.reshape(hkv, ng // g, g, *x.shape[2:]).swapaxes(1, 2).reshape(
+        hkv * g, ng // g, *x.shape[2:])
+
+
 def _flash_forward(causal: bool, q, k, v):
     """Chunked forward returning ``(o, L)``: the attention output and the
     per-row logsumexp ``L = m + log l`` of the *scaled* scores — the only
     row statistic the flash backward needs to recompute any block's
     normalised probabilities as ``exp(s - L)``. Padded/fully-masked rows
     get ``L = -_NEG`` (huge) so recomputed probabilities underflow to 0.
+
+    GQA/MQA: ``k``/``v`` may carry ``hkv = h // g`` heads; q is folded to
+    ``(hkv, n*g, d)`` (see :func:`_fold_groups`) and the returned ``L``
+    stays in that FOLDED layout — the backward consumes it directly.
     """
     h, n, d = q.shape
+    hkv = k.shape[0]
+    g = h // hkv
     c = _Q_CHUNK
+    cg = c * g  # folded q rows per chunk
     nc = -(-n // c)
     pad = nc * c - n
     q32 = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, pad), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
-    qs, ks, vs = _chunk(q32, nc, c), _chunk(kp, nc, c), _chunk(vp, nc, c)
+    qs = _chunk(_fold_groups(q32, hkv, g), nc, cg)
+    ks, vs = _chunk(kp, nc, c), _chunk(vp, nc, c)
+    rep = jnp.arange(cg) // g  # folded row -> within-chunk position
 
     def body_q(_, xs):
         qc, ci = xs
-        qpos = ci * c + jnp.arange(c)
+        qpos = ci * c + rep
 
         def body_k(carry, ys):
             oc, mc, lc = carry
@@ -328,9 +363,9 @@ def _flash_forward(causal: bool, q, k, v):
                 oc, mc, lc = upd((kb, vb, oc, mc, lc))
             return (oc, mc, lc), None
 
-        o0 = jnp.zeros((h, c, d), jnp.float32)
-        m0 = jnp.full((h, c), _NEG, jnp.float32)
-        l0 = jnp.zeros((h, c), jnp.float32)
+        o0 = jnp.zeros((hkv, cg, d), jnp.float32)
+        m0 = jnp.full((hkv, cg), _NEG, jnp.float32)
+        l0 = jnp.zeros((hkv, cg), jnp.float32)
         (oc, mc, lc), _ = lax.scan(
             body_k, (o0, m0, l0), (ks, vs, jnp.arange(nc)))
         Lc = jnp.where(lc > 0, mc + jnp.log(jnp.maximum(lc, 1e-37)), -_NEG)
@@ -338,7 +373,8 @@ def _flash_forward(causal: bool, q, k, v):
         return None, (oc, Lc)
 
     _, (os_, Ls) = lax.scan(body_q, None, (qs, jnp.arange(nc)))
-    return _unchunk(os_)[:, :n, :].astype(q.dtype), _unchunk(Ls)
+    o = _unfold_groups(_unchunk(os_), hkv, g)[:, :n, :].astype(q.dtype)
+    return o, _unchunk(Ls)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
@@ -364,7 +400,10 @@ def _flash_chunked_bwd(causal: bool, res, do):
     """
     q, k, v, o, L = res
     h, n, d = q.shape
+    hkv = k.shape[0]
+    g = h // hkv
     c = _Q_CHUNK
+    cg = c * g
     nc = -(-n // c)
     pad = nc * c - n
     scale = 1.0 / math.sqrt(d)
@@ -374,19 +413,22 @@ def _flash_chunked_bwd(causal: bool, res, do):
         return jnp.pad(x.astype(f32), ((0, 0), (0, pad), (0, 0)),
                        constant_values=fill)
 
-    q32, k32, v32 = padded(q), padded(k), padded(v)
-    do32, o32 = padded(do), padded(o)
-    Lp = L  # already padded to nc*c by the forward (pad rows = -_NEG)
-    D = jnp.sum(do32 * o32, axis=-1)  # (h, nc*c)
-    qs, ks, vs = (_chunk(x, nc, c) for x in (q32, k32, v32))
-    dos = _chunk(do32, nc, c)
-    Ls, Ds = _chunk(Lp, nc, c), _chunk(D, nc, c)
+    k32, v32 = padded(k), padded(v)
+    q32 = _fold_groups(padded(q), hkv, g)
+    do32 = _fold_groups(padded(do), hkv, g)
+    o32 = _fold_groups(padded(o), hkv, g)
+    Lp = L  # saved FOLDED and padded by the forward (pad rows = -_NEG)
+    D = jnp.sum(do32 * o32, axis=-1)  # (hkv, nc*c*g)
+    qs, dos = _chunk(q32, nc, cg), _chunk(do32, nc, cg)
+    ks, vs = _chunk(k32, nc, c), _chunk(v32, nc, c)
+    Ls, Ds = _chunk(Lp, nc, cg), _chunk(D, nc, cg)
     ar = jnp.arange(c)
+    rep = jnp.arange(cg) // g  # folded row -> within-chunk position
 
     def probs(qc, kb, Lc, ci, kj):
         s = jnp.einsum("hqd,hkd->hqk", qc, kb,
                        preferred_element_type=f32) * scale
-        mask = _mask_from_pos(ci * c + ar, kj * c + ar, n, causal)
+        mask = _mask_from_pos(ci * c + rep, kj * c + ar, n, causal)
         return jnp.where(mask, jnp.exp(s - Lc[..., None]), 0.0)
 
     def body_dq(_, xs):
@@ -409,7 +451,7 @@ def _flash_chunked_bwd(causal: bool, res, do):
                 dqc = upd(dqc)
             return dqc, None
 
-        dqc, _ = lax.scan(body_k, jnp.zeros((h, c, d), f32),
+        dqc, _ = lax.scan(body_k, jnp.zeros((hkv, cg, d), f32),
                           (ks, vs, jnp.arange(nc)))
         return None, dqc
 
@@ -424,6 +466,8 @@ def _flash_chunked_bwd(causal: bool, res, do):
             def upd(carry):
                 dkc, dvc = carry
                 p = probs(qc, kb, Lc, ci, kj)
+                # Folded q rows carry all g groups: these einsums sum the
+                # group contributions into the hkv kv heads directly.
                 dvc = dvc + jnp.einsum("hqk,hqd->hkd", p, doc,
                                        preferred_element_type=f32)
                 dp = jnp.einsum("hqd,hkd->hqk", doc, vb,
@@ -439,13 +483,13 @@ def _flash_chunked_bwd(causal: bool, res, do):
                 carry = upd(carry)
             return carry, None
 
-        z = jnp.zeros((h, c, d), f32)
+        z = jnp.zeros((hkv, c, d), f32)
         (dkc, dvc), _ = lax.scan(
             body_q, (z, z), (qs, dos, Ls, Ds, jnp.arange(nc)))
         return None, (dkc, dvc)
 
     _, (dks, dvs) = lax.scan(body_dkv, None, (ks, vs, jnp.arange(nc)))
-    dq = _unchunk(dqs)[:, :n, :].astype(q.dtype)
+    dq = _unfold_groups(_unchunk(dqs), hkv, g)[:, :n, :].astype(q.dtype)
     dk = _unchunk(dks)[:, :n, :].astype(k.dtype)
     dv = _unchunk(dvs)[:, :n, :].astype(v.dtype)
     return dq, dk, dv
@@ -546,10 +590,11 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool):
     qh = lax.all_to_all(q, axis, split_axis=0, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis, split_axis=0, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis, split_axis=0, concat_axis=1, tiled=True)
-    # GQA with hkv % p == 0 reaches here un-expanded (the contiguous
-    # q-head block on each device maps exactly onto its kv-head block);
-    # broadcast across the local groups only now, after the wire.
-    kh, vh = _repeat_heads(kh, vh, qh.shape[0] // kh.shape[0])
+    # GQA with hkv % p == 0 stays un-expanded end to end: the contiguous
+    # q-head block on each device maps exactly onto its kv-head block on
+    # the wire, and the flash-chunked path then folds query groups
+    # against the (hkv, ...) K/V directly (the small-n dense fallback
+    # expands internally).
     oh = _attention_chunked(qh, kh, vh, causal=causal)
     # (H/p, n_global, d) -> (H, n_local, d).
     return lax.all_to_all(oh, axis, split_axis=1, concat_axis=0, tiled=True)
@@ -568,7 +613,10 @@ def ulysses_attention(
     Requires ``heads`` divisible by the mesh size (each device computes full
     attention for ``heads/p`` heads). Two ``all_to_all`` collectives per
     call instead of ring hops; exact softmax, no online accumulation
-    needed. GQA/MQA K/V heads are broadcast to the query heads first.
+    needed. GQA/MQA K/V heads whose count splits over the mesh stay
+    un-expanded end to end (wire and local compute — the flash path
+    folds query groups instead); otherwise they are pre-expanded just
+    enough to split.
     """
     if mesh is None:
         mesh = mesh_lib.make_mesh_1d(axis=axis)

@@ -242,6 +242,42 @@ def test_flash_backward_parity(rng, causal, n, small_chunks):
             err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("hq,hkv", [(4, 2), (8, 1)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_folded_parity(rng, hq, hkv, causal, small_chunks):
+    """The GQA fold path (query groups folded into the row axis, K/V
+    un-expanded) through the flash forward AND custom backward, at a
+    chunked non-multiple length — vs the dense oracle on repeated K/V."""
+    from mpi_and_open_mp_tpu.parallel.context import _attention_chunked
+
+    small_chunks(16)
+    n, d = 72, 8
+    q = jnp.asarray(rng.standard_normal((hq, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    g = hq // hkv
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(_attention_chunked(q_, k_, v_, causal) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(attention_reference(
+            q_, jnp.repeat(k_, g, axis=0), jnp.repeat(v_, g, axis=0),
+            causal=causal) ** 2)
+
+    got = _attention_chunked(q, k, v, causal)
+    want = attention_reference(q, jnp.repeat(k, g, axis=0),
+                               jnp.repeat(v, g, axis=0), causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gg, gw, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gw),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
+
+
 def test_flash_backward_bf16_dtypes(rng, small_chunks):
     """bf16 primals get bf16 gradients (f32 accumulation inside)."""
     from mpi_and_open_mp_tpu.parallel.context import _attention_chunked

@@ -98,6 +98,66 @@ def test_plot_network_script(tmp_path, monkeypatch, capsys):
     assert "alpha=" in capsys.readouterr().out
 
 
+def test_plot_bigboard_script(tmp_path):
+    csv = tmp_path / "bb.csv"
+    csv.write_text(
+        "n,steps,path,steady_us_per_step,steady_gcups,differenced\n"
+        "500,1000,vmem,0.2,1200.0,1\n"
+        "2048,500,fused,2.0,2100.0,1\n"
+        "9000,100,frame,60.0,1350.0,1\n")
+    out = tmp_path / "bb.png"
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import plot_bigboard
+
+    rc = plot_bigboard.main(["plot_bigboard", str(csv), str(out)])
+    assert rc == 0 and out.exists() and out.stat().st_size > 1000
+
+
+def test_plot_attention_script_with_and_without_bwd(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import plot_attention
+
+    full = tmp_path / "att.csv"
+    full.write_text("seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced\n"
+                    "8192,0.003,48.0,0.010,47.0,1\n"
+                    "16384,0.012,46.0,0.042,45.0,1\n")
+    out = tmp_path / "att.png"
+    rc = plot_attention.main(["plot_attention", str(full), str(out)])
+    assert rc == 0 and out.stat().st_size > 1000
+    # All-forward CSV (e.g. --bwd-max 0): must render, not crash.
+    fwd_only = tmp_path / "att_f.csv"
+    fwd_only.write_text("seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,"
+                        "differenced\n8192,0.003,48.0,,,1\n")
+    out2 = tmp_path / "att_f.png"
+    rc = plot_attention.main(["plot_attention", str(fwd_only), str(out2)])
+    assert rc == 0 and out2.stat().st_size > 1000
+
+
+def test_sweep_scripts_refuse_off_tpu(tmp_path):
+    """The real-chip sweep recorders must refuse to record from a CPU
+    backend rather than committing dishonest numbers."""
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import sweep_attention
+    import sweep_bigboard
+
+    for mod in (sweep_bigboard, sweep_attention):
+        rc = mod.main(["--out", str(tmp_path / "x.csv")])
+        assert rc == 1
+        assert not (tmp_path / "x.csv").exists()
+
+
+def test_native_path_matches_dispatcher_gates():
+    """native_path is the single source of truth the sweeps label rows
+    with; pin its decisions at the regime boundaries."""
+    from mpi_and_open_mp_tpu.ops.pallas_life import native_path
+
+    assert native_path((500, 500)) == "vmem"
+    assert native_path((3072, 3072)) == "vmem"
+    assert native_path((8192, 8192)) == "fused"
+    assert native_path((10000, 10000)) == "frame"  # ny % 32 != 0
+    assert native_path((8192, 8192), on_tpu=False) == "xla"
+
+
 def test_hello_app(capsys):
     rc = hello_app.main(["--devices", "8"])
     assert rc == 0
@@ -142,7 +202,15 @@ def test_committed_results_layer_parses():
         rows = plot_network.load_csv(os.path.join(results, rel))
         assert len(rows) == 7 and rows[0][0] == 1, rel
         assert all(t > 0 for _, t in rows), rel
-    for png in ("life/life_accel_virtual8.png", "network/network_params.png"):
+    import csv as csv_mod
+
+    for rel, col in (("life/bigboard_tpu.csv", "steady_gcups"),
+                     ("attention/attention_tpu.csv", "fwd_tflops")):
+        with open(os.path.join(results, rel)) as f:
+            rows = list(csv_mod.DictReader(f))
+        assert rows and all(float(r[col]) > 0 for r in rows), rel
+    for png in ("life/life_accel_virtual8.png", "network/network_params.png",
+                "life/bigboard_tpu.png", "attention/attention_tpu.png"):
         assert os.path.getsize(os.path.join(results, png)) > 1000, png
 
 
