@@ -143,15 +143,20 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     ``parallel/halo.py:halo_pad_y`` (reference: ``3-life/life_mpi.c:203-207``).
     """
     p = lax.axis_size(axis)
-    groups = q.shape[0] // k.shape[0]
     if p == 1:
         # A 1-device ring is just full local attention; the doubly-chunked
         # local path additionally skips future k blocks under causal.
         # GQA stays un-expanded: the flash path folds query groups.
         return _attention_chunked(q, k, v, causal)
     idx = lax.axis_index(axis)
-    h, nl, d = q.shape
-    q32 = q.astype(jnp.float32)
+    nl, d = q.shape[1:]
+    hkv = k.shape[0]
+    g = q.shape[0] // hkv
+    # GQA stays un-expanded through the whole ring: K/V blocks ride the
+    # ppermutes at hkv heads and the folds run q with query groups
+    # folded into the row axis (row r <-> position r // g), exactly like
+    # the local flash path — no repeated K/V is ever materialised.
+    q32 = _fold_groups(q.astype(jnp.float32), hkv, g)
     perm = ring_perm(p, 1)
 
     # Flash-style q chunking whenever the shard is long: q rows are
@@ -160,11 +165,12 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     chunked = nl > _Q_CHUNK
     nc = -(-nl // _Q_CHUNK)
     nlp = nc * _Q_CHUNK if chunked else nl
+    cg = _Q_CHUNK * g
     if chunked and nlp != nl:
-        q32 = jnp.pad(q32, ((0, 0), (0, nlp - nl), (0, 0)))
-    o0 = jnp.zeros((h, nlp, d), jnp.float32)
-    m0 = jnp.full((h, nlp), _NEG, jnp.float32)
-    l0 = jnp.zeros((h, nlp), jnp.float32)
+        q32 = jnp.pad(q32, ((0, 0), (0, (nlp - nl) * g), (0, 0)))
+    o0 = jnp.zeros((hkv, nlp * g, d), jnp.float32)
+    m0 = jnp.full((hkv, nlp * g), _NEG, jnp.float32)
+    l0 = jnp.zeros((hkv, nlp * g), jnp.float32)
 
     def fold(j, o, m, l, kb, vb):
         # After j forward rotations my K/V block originated on ring
@@ -174,22 +180,20 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
 
         def compute(args):
             kb, vb, o, m, l = args
-            # GQA: expand K/V heads locally — the ring moved only the
-            # hkv-head blocks.
-            kb, vb = _repeat_heads(kb, vb, groups)
             if not chunked:
-                qpos = idx * nl + jnp.arange(nl)
+                qpos = idx * nl + jnp.arange(nl * g) // g
                 return _block_update(q32, kb, vb, qpos, kpos, None, causal,
                                      o, m, l)
-            # Scan q (and its running state) in (h, _Q_CHUNK) slices so
-            # only a (h, _Q_CHUNK, nl) score block is ever live.
+            # Scan q (and its running state) in (hkv, _Q_CHUNK * g)
+            # folded slices so only a (hkv, _Q_CHUNK * g, nl) score
+            # block is ever live.
 
             def to_chunks(x):
-                return _chunk(x, nc, _Q_CHUNK)
+                return _chunk(x, nc, cg)
 
             def body(_, xs):
                 qc, oc, mc, lc, ci = xs
-                qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(_Q_CHUNK)
+                qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(cg) // g
                 oc, mc, lc = _block_update(qc, kb, vb, qpos, kpos, None,
                                            causal, oc, mc, lc)
                 return None, (oc, mc, lc)
@@ -236,9 +240,9 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     o, m, l, kb, vb = lax.fori_loop(0, p - 1, hop, (o0, m0, l0, k, v))
     o, m, l = fold(p - 1, o, m, l, kb, vb)
     if nlp != nl:
-        o, l = o[:, :nl], l[:, :nl]
+        o, l = o[:, : nl * g], l[:, : nl * g]
     o = o / jnp.where(l > 0, l, 1.0)[..., None]
-    return o.astype(q.dtype)
+    return _unfold_groups(o, hkv, g).astype(q.dtype)
 
 
 def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
@@ -526,11 +530,12 @@ def _check_gqa(q, k, v, what: str) -> int:
 
 
 def _repeat_heads(k, v, groups: int):
-    """Broadcast K/V heads across query-head groups. The ring keeps this
-    entirely LOCAL (un-expanded K/V ride the ppermutes, expansion happens
-    per fold in VMEM); Ulysses keeps it local whenever the head count
-    splits over the mesh, expanding pre-wire only as a last resort (and
-    then minimally — see ulysses_attention)."""
+    """Broadcast K/V heads across query-head groups. The compute paths
+    avoid this entirely (ring and flash-chunked fold query groups into
+    the row axis instead — see :func:`_fold_groups`); it remains for the
+    dense small-n oracle fallback and Ulysses' pre-wire expansion when
+    the kv-head count doesn't split over the mesh (and then minimally —
+    see ulysses_attention)."""
     if groups == 1:
         return k, v
     return jnp.repeat(k, groups, axis=0), jnp.repeat(v, groups, axis=0)

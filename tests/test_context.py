@@ -165,6 +165,41 @@ def test_ring_attention_chunked_nondivisible(rng, sp_mesh, small_chunks):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("hq,hkv,n", [(4, 2, 512), (8, 1, 512),
+                                      (4, 2, 456)])
+def test_ring_gqa_folded_chunked_parity(rng, sp_mesh, hq, hkv, n,
+                                        small_chunks):
+    """Multi-hop ring with GQA folded rows AND per-fold q chunking (the
+    un-expanded-K/V ring path), incl. gradients. n=456 makes n_local=57
+    a NON-multiple of the chunk, exercising the g-scaled folded padding
+    and the `nl * g` slice."""
+    small_chunks(16)  # n_local = 64 (or 57) -> 4 folded chunks
+    d = 8
+    q = jnp.asarray(rng.standard_normal((hq, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    g = hq // hkv
+    kr, vr = jnp.repeat(k, g, axis=0), jnp.repeat(v, g, axis=0)
+    got = ring_attention(q, k, v, mesh=sp_mesh, causal=True)
+    want = attention_reference(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    g_got = jax.grad(
+        lambda q_, k_, v_: jnp.sum(
+            ring_attention(q_, k_, v_, mesh=sp_mesh, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(
+        lambda q_, k_, v_: jnp.sum(attention_reference(
+            q_, jnp.repeat(k_, g, axis=0), jnp.repeat(v_, g, axis=0),
+            causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for gg, gw, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gw),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
+
+
 def test_ulysses_attention_chunked_parity(rng, sp_mesh, small_chunks):
     small_chunks(32)  # n_global = 512 -> 16 chunks
     q, k, v = _qkv(rng, 8, 512, 16)
