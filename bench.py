@@ -15,6 +15,7 @@ held 1.25-1.29e12). End-to-end time/rate stay as secondary fields.
 """
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -169,7 +170,7 @@ def main(argv=None) -> int:
         import jax.numpy as jnp
         from jax import lax as jlax
 
-        from mpi_and_open_mp_tpu.parallel.context import _attention_chunked
+        from mpi_and_open_mp_tpu.parallel.context import flash_attention
         from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
         h, n, d = 8, 32 * 1024, 128
@@ -179,19 +180,20 @@ def main(argv=None) -> int:
         @jax.jit
         def chain(q, k, v, r):
             return jlax.fori_loop(
-                0, r, lambda _, c: _attention_chunked(c, k, v, True), q
+                0, r, lambda _, c: flash_attention(c, k, v, causal=True), q
             )
 
-        def timed(r):
+        def timed(call):
             best_r = float("inf")
             for _ in range(3):
                 t0 = time.perf_counter()
-                anchor_sync(chain(*qkv, jnp.int32(r)), fetch_all=True)
+                anchor_sync(call(), fetch_all=True)
                 best_r = min(best_r, time.perf_counter() - t0)
             return best_r
 
         anchor_sync(chain(*qkv, jnp.int32(1)), fetch_all=True)  # compile
-        t_1, t_9 = timed(1), timed(9)
+        t_1 = timed(lambda: chain(*qkv, jnp.int32(1)))
+        t_9 = timed(lambda: chain(*qkv, jnp.int32(9)))
         # Same anomaly discipline as measure(): if jitter made the longer
         # chain "faster", report the end-to-end single call un-differenced
         # and flag it, rather than emitting a nonsense marginal rate.
@@ -203,6 +205,40 @@ def main(argv=None) -> int:
             "attention_32k_causal_tflops": round(flops / attn_sec / 1e12, 1),
             "attention_is_differenced": attn_diff,
         })
+
+        # Training path: the flash custom_vjp backward, FULL (q, k, v)
+        # gradients — grad wrt q alone lets XLA prune the dk+dv pass and
+        # overstate the rate. The chain is UNROLLED (python loop, static
+        # r): grad through a lax.scan of the custom_vjp stacks O(seq^2)
+        # forward intermediates per link (see parallel/context.py).
+        @functools.partial(jax.jit, static_argnames=("r",))
+        def grad_chain(q, k, v, r):
+            def loss(q_, k_, v_):
+                c = q_
+                for _ in range(r):
+                    c = flash_attention(c, k_, v_, causal=True)
+                return (c.astype(jnp.float32) ** 2).sum()
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        try:
+            anchor_sync(grad_chain(*qkv, r=1), fetch_all=True)  # compile
+            anchor_sync(grad_chain(*qkv, r=3), fetch_all=True)
+            g_1 = timed(lambda: grad_chain(*qkv, r=1))
+            g_3 = timed(lambda: grad_chain(*qkv, r=3))
+        except Exception as e:  # never lose the whole bench line to this
+            sharded["attention_bwd_error"] = f"{type(e).__name__}: {e}"[:200]
+        else:
+            bwd_diff = g_3 > g_1
+            bwd_sec = (g_3 - g_1) / 2 if bwd_diff else g_1
+            sharded.update({
+                # fwd+bwd = 3.5x the fwd FLOPs (bwd = 5 block matmuls
+                # vs 2).
+                "attention_32k_bwd_sec": round(bwd_sec, 5),
+                "attention_32k_bwd_tflops": round(
+                    3.5 * flops / bwd_sec / 1e12, 1),
+                "attention_bwd_is_differenced": bwd_diff,
+            })
     print(json.dumps({
         "metric": "life_steady_cups_p46gun_big",
         "value": round(steady_cups, 1),

@@ -22,7 +22,10 @@ from mpi_and_open_mp_tpu.apps._common import add_platform_args, apply_platform_a
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mpi_and_open_mp_tpu.apps.attention")
-    p.add_argument("--variant", choices=("ring", "ulysses"), default="ring")
+    p.add_argument("--variant", choices=("ring", "ulysses", "flash"),
+                   default="ring",
+                   help="sharded ring / sharded all-to-all / single-"
+                   "device flash-chunked (no mesh)")
     p.add_argument("--seq", type=int, default=8192)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
@@ -50,9 +53,15 @@ def main(argv=None) -> int:
 
     from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
 
-    mesh = mesh_lib.make_mesh_1d(args.devices, axis=context.AXIS_SP)
-    fn = (context.ring_attention if args.variant == "ring"
-          else context.ulysses_attention)
+    if args.variant == "flash":
+        mesh = mesh_lib.make_mesh_1d(1, axis=context.AXIS_SP)  # size only
+
+        def fn(q, k, v, mesh=None, causal=False):
+            return context.flash_attention(q, k, v, causal=causal)
+    else:
+        mesh = mesh_lib.make_mesh_1d(args.devices, axis=context.AXIS_SP)
+        fn = (context.ring_attention if args.variant == "ring"
+              else context.ulysses_attention)
     dtype = jnp.dtype(args.dtype)
     rng = np.random.default_rng(args.seed)
     hkv = args.kv_heads or args.heads

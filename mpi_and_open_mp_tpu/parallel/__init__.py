@@ -15,6 +15,7 @@ from mpi_and_open_mp_tpu.parallel.halo import (  # noqa: F401
 from mpi_and_open_mp_tpu.parallel import fabric  # noqa: F401
 from mpi_and_open_mp_tpu.parallel.context import (  # noqa: F401
     attention_reference,
+    flash_attention,
     ring_attention,
     ulysses_attention,
     AXIS_SP,

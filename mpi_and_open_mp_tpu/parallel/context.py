@@ -582,6 +582,20 @@ def ring_attention(
                                   mesh=mesh, axis=axis, causal=causal)
 
 
+def flash_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False
+) -> jnp.ndarray:
+    """Single-device flash-chunked attention — the local engine behind
+    ``ring_attention``/``ulysses_attention``, exposed for unsharded use
+    (one-chip training steps, benches). Exact softmax in O(chunk·seq)
+    memory, the flash ``custom_vjp`` backward (O(seq·d) residuals), and
+    GQA/MQA K/V heads run un-expanded (query groups fold into the row
+    axis). Shapes ``(heads, seq, head_dim)``; ``k``/``v`` may carry
+    fewer heads as long as they divide ``q``'s."""
+    _check_gqa(q, k, v, "flash_attention")
+    return _attention_chunked(q, k, v, causal)
+
+
 def _ulysses_local(q, k, v, *, axis: str, causal: bool):
     """Per-shard body: all-to-all seq->head re-shard, local attention, back.
 

@@ -433,6 +433,24 @@ def test_gqa_indivisible_heads_raises(rng, sp_mesh):
         ring_attention(q, k[:3], v[:3], mesh=sp_mesh)
 
 
+def test_flash_attention_public_api(rng, small_chunks):
+    """The exported single-device flash engine: chunked, GQA, grads."""
+    from mpi_and_open_mp_tpu.parallel import flash_attention
+
+    small_chunks(16)
+    q = jnp.asarray(rng.standard_normal((4, 72, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 72, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 72, 8)), jnp.float32)
+    got = flash_attention(q, k, v, causal=True)
+    want = attention_reference(q, jnp.repeat(k, 2, axis=0),
+                               jnp.repeat(v, 2, axis=0), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    k3 = jnp.asarray(rng.standard_normal((3, 72, 8)), jnp.float32)
+    with pytest.raises(ValueError, match="not a multiple"):
+        flash_attention(q, k3, k3)
+
+
 def test_ring_attention_default_mesh(rng):
     q, k, v = _qkv(rng, 2, 64, 8)
     got = ring_attention(q, k, v, causal=False)
