@@ -1,8 +1,8 @@
 """Long-context attention scaling sweep on the real chip.
 
 Times the flash-chunked causal attention kernel that carries the
-long-context layer's per-shard compute (`parallel.context._attention_chunked`
-— the same code `ring_attention` folds per hop and `ulysses_attention` runs
+long-context layer's per-shard compute (`parallel.flash_attention` — the
+same engine `ring_attention` folds per hop and `ulysses_attention` runs
 per head group) across sequence lengths, forward and backward (the
 rematerialised training path), in bfloat16 at (8 heads, d=128).
 
@@ -50,7 +50,7 @@ def main(argv=None) -> int:
         return 1
 
     from mpi_and_open_mp_tpu.parallel.context import (
-        _attention_chunked, attention_reference)
+        attention_reference, flash_attention)
     from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
     rng = np.random.default_rng(0)
@@ -64,7 +64,7 @@ def main(argv=None) -> int:
     q0, k0, v0 = (jnp.asarray(rng.standard_normal((HEADS, n0, DIM)),
                               jnp.float32) for _ in range(3))
     with jax.default_matmul_precision("highest"):
-        got = _attention_chunked(q0, k0, v0, True)
+        got = flash_attention(q0, k0, v0, causal=True)
         want = attention_reference(q0, k0, v0, causal=True)
     if not np.allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
                        atol=2e-4):
@@ -74,7 +74,7 @@ def main(argv=None) -> int:
     @functools.partial(jax.jit, static_argnames=("r",))
     def fwd_chain(q, k, v, r):
         out, _ = lax.scan(
-            lambda c, _: (_attention_chunked(c, k, v, True), None),
+            lambda c, _: (flash_attention(c, k, v, causal=True), None),
             q, None, length=r)
         return out
 
@@ -90,7 +90,7 @@ def main(argv=None) -> int:
         def loss(q_, k_, v_):
             c = q_
             for _ in range(r):
-                c = _attention_chunked(c, k_, v_, True)
+                c = flash_attention(c, k_, v_, causal=True)
             return (c.astype(jnp.float32) ** 2).sum()
 
         # All three grads: grad wrt q alone lets XLA prune the flash

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
 from mpi_and_open_mp_tpu.parallel.context import (
     attention_reference,
+    flash_attention,
     ring_attention,
     ulysses_attention,
 )
@@ -86,9 +87,8 @@ def test_random_attention_parity(case, rng):
 
     if variant == "local":
         def fn(q_, k_, v_):
-            kk = jnp.repeat(k_, h // hkv, axis=0)
-            vv = jnp.repeat(v_, h // hkv, axis=0)
-            return context._attention_chunked(q_, kk, vv, causal)
+            # Public single-device engine; GQA stays un-expanded.
+            return flash_attention(q_, k_, v_, causal=causal)
     else:
         mesh = mesh_lib.make_mesh_1d(p, axis="sp")
         impl = ring_attention if variant == "ring" else ulysses_attention
