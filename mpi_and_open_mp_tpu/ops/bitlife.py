@@ -4,7 +4,7 @@ The reference's compute kernel spends ~12 arithmetic ops per cell on the
 8-neighbour count (``/root/reference/3-life/life2d.c:104-130``). On a TPU
 VPU the state is 1 bit, so the idiomatic kernel packs 32 cells into each
 uint32 **along y** (the sublane axis) and evaluates the rule with bitwise
-carry-save adders — ~42 vector ops per 32 cells ≈ 1.3 ops/cell, and 32x
+carry-save adders — ~35 vector ops per 32 cells ≈ 1.1 ops/cell, and 32x
 less VMEM/HBM traffic than an int32 board. This is the framework's fast
 path for single-shard boards; it is bit-exact against the NumPy oracle
 (tests/test_bitlife.py exercises odd sizes, gliders, and random soups).
@@ -17,11 +17,10 @@ refreshes the two ghost bits from live state, then
 * y-neighbours are single-bit shifts across the packed words (cross-word
   carries via a sublane roll),
 * x-neighbours are lane rolls with the exact ``nx`` wrap (no padding in x),
-* the 9-cell sum ``T`` is built as 2-bit column sums combined by full
-  adders into a mod-8 count (the bit-3 carry is unreachable by the two
-  tested values — see ``_carry_save_rule``), and the rule is
-  ``T==3 | (alive & T==4)`` (the +1-including-centre form of birth-on-3
-  / survive-on-2-or-3, ``life2d.c:117-123``).
+* the 8-neighbour count ``N`` is built as 2-bit column sums combined by
+  full adders into a mod-8 count (N==8 wraps to 0 and correctly dies —
+  see ``_carry_save_rule``), and the rule is ``(n0|alive) & n1 & ~n2``
+  (birth-on-3 / survive-on-2-or-3, ``life2d.c:117-123``).
 
 The whole step loop runs inside one ``pallas_call`` with the packed board
 VMEM-resident; a 500x500 board packs to 16x500 uint32 = 32 KB. The gate
@@ -140,33 +139,43 @@ def _carry_save_rule(c, up, dn, roll_left, roll_right) -> jnp.ndarray:
     torus neighbour — plain rolls when the array width IS the board
     width, rolls + wrap-column fixup on the lane-padded fast path.
 
-    The 9-cell total ``T`` (centre included) is accumulated only mod 8:
-    the bit-3 carry is unreachable by the two tested values (``T <= 9``,
-    and neither 3+8=11 nor 4+8=12 can occur), so dropping it — and
-    folding the two equality tests into a shared-subterm form — shaves
-    the op chain ~15% vs the full 4-bit adder (bit-exactness pinned by
-    the three-oracle parity suite, rule spec ``3-life/life2d.c:104-130``).
+    Counts the 8 NEIGHBOURS ``N`` (centre excluded), mod 8 — the bit-3
+    carry only fires at N == 8, which wraps to 000 and correctly dies.
+    Excluding the centre is what makes the rule term cheap: birth-on-3 /
+    survive-on-2-or-3 becomes ``(n0 | alive) & n1 & ~n2`` (N==3 sets it
+    regardless of ``alive``; N==2 needs ``alive`` to supply bit 0), four
+    ops versus eight for the centre-included ``T==3 | (alive & T==4)``
+    form — ~24 logicals per 32 cells all told. The neighbour columns
+    still contribute their full 3-cell sums (``ys``), whose half-adder
+    prefix is the centre column's 2-cell sum (``cs``) — shared, so both
+    cost 5 ops together. Bit-exactness is pinned by the three-oracle
+    parity suite (rule spec ``3-life/life2d.c:104-130``).
     """
-    # 2-bit column sums up+centre+down (carry-save adder).
-    z = up ^ c
-    ys0 = z ^ dn
-    ys1 = (up & c) | (dn & z)
+    # Column sums: cs = up+dn (centre column, centre EXCLUDED) and
+    # ys = up+c+dn (what this column contributes as a NEIGHBOUR column).
+    cs0 = up ^ dn
+    cs1 = up & dn
+    ys0 = cs0 ^ c
+    ys1 = cs1 | (cs0 & c)
     # x-neighbours.
     l0 = roll_left(ys0)
     r0 = roll_right(ys0)
     l1 = roll_left(ys1)
     r1 = roll_right(ys1)
-    # T = left + centre + right column sums, bits (t2, t1, t0) = T mod 8.
-    x = l0 ^ ys0
-    t0 = x ^ r0
-    k0 = (l0 & ys0) | (r0 & x)
-    y = l1 ^ ys1
-    u0 = y ^ r1
-    u1 = (l1 & ys1) | (r1 & y)
-    t1 = u0 ^ k0
-    t2 = u1 ^ (u0 & k0)
-    # alive' = (T == 3) | (alive & T == 4), with T including the centre.
-    return (t1 & t0 & ~t2) | (c & t2 & ~(t1 | t0))
+    # P = L + R (two 2-bit sums -> 3 bits).
+    p0 = l0 ^ r0
+    q0 = l0 & r0
+    p1x = l1 ^ r1
+    p1 = p1x ^ q0
+    p2 = (l1 & r1) | (p1x & q0)
+    # N = P + cs, bits (n2, n1, n0) = N mod 8.
+    n0 = p0 ^ cs0
+    rc = p0 & cs0
+    n1x = p1 ^ cs1
+    n1 = n1x ^ rc
+    n2 = p2 ^ ((p1 & cs1) | (n1x & rc))
+    # alive' = (N == 3) | (alive & N == 2).
+    return (n0 | c) & n1 & ~n2
 
 
 def _lane_rolls(shape: tuple[int, int], nx: int):
