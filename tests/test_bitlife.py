@@ -292,3 +292,23 @@ def test_frame_bits_steps_runtime_scalar_no_retrace():
     before = f._cache_size()
     bitlife.life_run_frame_bits(b, 7, interpret=True)
     assert f._cache_size() == before
+
+
+def test_rule_exhaustive_all_512_neighbourhoods():
+    """Every 3x3 neighbourhood through the packed rule. On a 3x3 torus a
+    cell's 8 neighbours are exactly the other 8 cells, so the 512 board
+    configurations enumerate the rule's full truth table — the one test
+    that can never be fooled by a lucky soup. Checked via the XLA packed
+    step (same _carry_save_rule as the Pallas kernels) against the
+    birth-on-3 / survive-on-2-or-3 spec directly, not another oracle."""
+    boards = np.stack([
+        np.array([(cfg >> b) & 1 for b in range(9)], dtype=np.uint8
+                 ).reshape(3, 3)
+        for cfg in range(512)
+    ])
+    for cfg in range(512):
+        b = boards[cfg]
+        got = np.asarray(bitlife.life_run_bits_xla(jnp.asarray(b), 1))
+        n = b.sum() - b[1, 1]  # 8-neighbour count of the centre
+        want_centre = 1 if (n == 3 or (b[1, 1] and n == 2)) else 0
+        assert got[1, 1] == want_centre, (cfg, b, got)
