@@ -392,10 +392,12 @@ def _flash_chunked_fwd(causal: bool, q, k, v):
 
 
 def _flash_chunked_bwd(causal: bool, res, do):
-    """Flash-attention backward: recompute each block's probabilities from
-    the saved logsumexp, two chunk-parallel passes (one producing dq, one
-    dk+dv — each a clean scan with no cross-chunk accumulation), causal
-    block skipping mirrored from the forward. Per block:
+    """Flash-attention backward: recompute each block's probabilities
+    from the saved logsumexp in ONE pass over the allowed (q-chunk,
+    k-chunk) blocks — each block's p and dp feed dq, dk and dv together
+    (dk/dv accumulate into per-k-chunk stacks by indexed adds carried
+    through the scans), causal block skipping mirrored from the
+    forward. Per block:
 
         p  = exp(s - L)            (recomputed, masked)
         D  = rowsum(do * o)
@@ -435,64 +437,60 @@ def _flash_chunked_bwd(causal: bool, res, do):
         mask = _mask_from_pos(ci * c + rep, kj * c + ar, n, causal)
         return jnp.where(mask, jnp.exp(s - Lc[..., None]), 0.0)
 
-    def body_dq(_, xs):
+    # ONE pass over the allowed (i, j) block triangle: each block's
+    # recomputed p and dp feed dq, dk AND dv together (5 matmuls/block —
+    # the separate dq and dk/dv passes each redid s and dp, 7 total).
+    # dk/dv accumulate into per-k-chunk stacks via indexed adds carried
+    # through the scans; XLA aliases scan carries in place.
+    def body_i(carry, xs):
+        dks, dvs = carry
         qc, doc, Lc, Dc, ci = xs
 
-        def body_k(dqc, ys):
+        def body_j(inner, ys):
+            dqc, dks, dvs = inner
             kb, vb, kj = ys
 
-            def upd(dqc):
+            def upd(_):
                 p = probs(qc, kb, Lc, ci, kj)
                 dp = jnp.einsum("hqd,hkd->hqk", doc, vb,
                                 preferred_element_type=f32)
                 t = p * (dp - Dc[..., None])
-                return dqc + scale * jnp.einsum(
-                    "hqk,hkd->hqd", t, kb, preferred_element_type=f32)
+                # Folded q rows carry all g groups: the dk/dv einsums
+                # sum the group contributions into the hkv kv heads.
+                return (
+                    scale * jnp.einsum("hqk,hkd->hqd", t, kb,
+                                       preferred_element_type=f32),
+                    scale * jnp.einsum("hqk,hqd->hkd", t, qc,
+                                       preferred_element_type=f32),
+                    jnp.einsum("hqk,hqd->hkd", p, doc,
+                               preferred_element_type=f32),
+                )
 
+            # Only the small per-block contributions pass through the
+            # causal-skip cond; the O(seq) accumulators stay pure scan
+            # carries (in-place aliasing is only guaranteed there — an
+            # accumulator routed through a cond branch may be copied
+            # per block, turning the O(seq) working set quadratic).
             if causal:
-                dqc = lax.cond(kj <= ci, upd, lambda x: x, dqc)
+                dqj, dkj, dvj = lax.cond(
+                    kj <= ci, upd,
+                    lambda _: (jnp.zeros((hkv, cg, d), f32),
+                               jnp.zeros((hkv, c, d), f32),
+                               jnp.zeros((hkv, c, d), f32)),
+                    None)
             else:
-                dqc = upd(dqc)
-            return dqc, None
+                dqj, dkj, dvj = upd(None)
+            return (dqc + dqj, dks.at[kj].add(dkj),
+                    dvs.at[kj].add(dvj)), None
 
-        dqc, _ = lax.scan(body_k, jnp.zeros((hkv, cg, d), f32),
-                          (ks, vs, jnp.arange(nc)))
-        return None, dqc
+        (dqc, dks, dvs), _ = lax.scan(
+            body_j, (jnp.zeros((hkv, cg, d), f32), dks, dvs),
+            (ks, vs, jnp.arange(nc)))
+        return (dks, dvs), dqc
 
-    _, dqs = lax.scan(body_dq, None, (qs, dos, Ls, Ds, jnp.arange(nc)))
-
-    def body_dkv(_, ys):
-        kb, vb, kj = ys
-
-        def body_q(carry, xs):
-            qc, doc, Lc, Dc, ci = xs
-
-            def upd(carry):
-                dkc, dvc = carry
-                p = probs(qc, kb, Lc, ci, kj)
-                # Folded q rows carry all g groups: these einsums sum the
-                # group contributions into the hkv kv heads directly.
-                dvc = dvc + jnp.einsum("hqk,hqd->hkd", p, doc,
-                                       preferred_element_type=f32)
-                dp = jnp.einsum("hqd,hkd->hqk", doc, vb,
-                                preferred_element_type=f32)
-                t = p * (dp - Dc[..., None])
-                dkc = dkc + scale * jnp.einsum(
-                    "hqk,hqd->hkd", t, qc, preferred_element_type=f32)
-                return dkc, dvc
-
-            if causal:
-                carry = lax.cond(ci >= kj, upd, lambda x: x, carry)
-            else:
-                carry = upd(carry)
-            return carry, None
-
-        z = jnp.zeros((hkv, c, d), f32)
-        (dkc, dvc), _ = lax.scan(
-            body_q, (z, z), (qs, dos, Ls, Ds, jnp.arange(nc)))
-        return None, (dkc, dvc)
-
-    _, (dks, dvs) = lax.scan(body_dkv, None, (ks, vs, jnp.arange(nc)))
+    z = jnp.zeros((nc, hkv, c, d), f32)
+    (dks, dvs), dqs = lax.scan(
+        body_i, (z, z), (qs, dos, Ls, Ds, jnp.arange(nc)))
     dq = _unfold_groups(_unchunk(dqs), hkv, g)[:, :n, :].astype(q.dtype)
     dk = _unchunk(dks)[:, :n, :].astype(k.dtype)
     dv = _unchunk(dvs)[:, :n, :].astype(v.dtype)
