@@ -15,7 +15,8 @@ coursework repo ``kekoveca/MPI-and-Open-MP``:
 * The reference's measurement harness contracts: ``.cfg`` inputs,
   elapsed-seconds stdout, VTK snapshots, ``times.txt`` accumulation.
 * Beyond the reference: a first-class long-context sequence-parallel
-  attention layer (ring + Ulysses, GQA, rematerialised backward —
+  attention layer (ring + Ulysses + single-device ``flash_attention``,
+  un-expanded GQA/MQA, flash ``custom_vjp`` backward —
   ``parallel.context``), bit-packed temporal-blocking Life kernels
   (one collective round per 128 steps — ``ops.bitlife``), Orbax
   checkpoint/resume, and a multi-host ``jax.distributed`` runtime.
