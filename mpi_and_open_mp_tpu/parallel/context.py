@@ -109,9 +109,10 @@ def _block_update(q32, k, v, qpos, kpos, n, causal, o, m, l):
     in here (rather than passing it) matters for the same reason: a
     passed mask is a checkpoint residual — O(hq·nq·nk) bools per block
     stacked across the ring/scan — where the position vectors are O(n).
-    (The local chunked path doesn't rely on this — it has a real flash
-    backward, ``_flash_chunked_bwd``; this remat path carries the
-    multi-device ring backward.)
+    (Neither production path differentiates through this any more: the
+    local chunked path has ``_flash_chunked_bwd`` and the multi-device
+    ring has ``_ring_flash_bwd``; the remat decorator remains as a
+    safety net for any future caller that autodiffs a fold directly.)
     """
     d = q32.shape[-1]
     mask = _mask_from_pos(qpos, kpos, n, causal)
@@ -141,6 +142,11 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     folds it into the online softmax; K/V then move one hop forward — the
     attention analogue of the ghost-row ``ppermute`` at
     ``parallel/halo.py:halo_pad_y`` (reference: ``3-life/life_mpi.c:203-207``).
+
+    Differentiation takes the ring flash backward (``_ring_flash``'s
+    ``custom_vjp``): the forward saves only ``(q, k, v, o, logsumexp)``
+    per shard — O(seq·d/p) — and the backward re-rotates K/V around the
+    ring, recomputing each block from the saved row statistics.
     """
     p = lax.axis_size(axis)
     if p == 1:
@@ -148,6 +154,15 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
         # local path additionally skips future k blocks under causal.
         # GQA stays un-expanded: the flash path folds query groups.
         return _attention_chunked(q, k, v, causal)
+    return _ring_flash(axis, causal, q, k, v)
+
+
+def _ring_forward(axis: str, causal: bool, q, k, v):
+    """The rotate-and-fold forward; returns the normalised output and the
+    per-row logsumexp ``L = m + log l`` of the scaled scores in the FOLDED
+    GQA layout ``(hkv, n_local·g)`` — the one statistic the ring backward
+    needs to recompute any hop's probabilities as ``exp(s - L)``."""
+    p = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     nl, d = q.shape[1:]
     hkv = k.shape[0]
@@ -240,9 +255,174 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     o, m, l, kb, vb = lax.fori_loop(0, p - 1, hop, (o0, m0, l0, k, v))
     o, m, l = fold(p - 1, o, m, l, kb, vb)
     if nlp != nl:
-        o, l = o[:, : nl * g], l[:, : nl * g]
+        o, m, l = o[:, : nl * g], m[:, : nl * g], l[:, : nl * g]
+    L = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-37)), -_NEG)
     o = o / jnp.where(l > 0, l, 1.0)[..., None]
-    return _unfold_groups(o, hkv, g).astype(q.dtype)
+    return _unfold_groups(o, hkv, g).astype(q.dtype), L
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ring_flash(axis: str, causal: bool, q, k, v):
+    return _ring_forward(axis, causal, q, k, v)[0]
+
+
+def _ring_flash_fwd(axis: str, causal: bool, q, k, v):
+    o, L = _ring_forward(axis, causal, q, k, v)
+    return o, (q, k, v, o, L)
+
+
+def _flash_block_grads(qc, doc, Lc, Dc, kb, vb, mask, scale: float):
+    """One block of the flash backward — THE shared arithmetic of the
+    chunked (``_flash_chunked_bwd``) and ring (``_ring_flash_bwd``)
+    backwards, so the two paths cannot drift numerically:
+
+        p  = exp(s - L)            (recomputed; ``mask`` = allow or None)
+        dv = pᵀ do ;  t = p∘(do vᵀ - D)
+        dq = scale · t k ;  dk = scale · tᵀ q
+
+    All operands float32. Folded GQA q rows carry all g groups: the
+    dk/dv einsums sum the group contributions into the hkv kv heads.
+    Returns ``(dq, dk, dv)`` for the block.
+    """
+    f32 = jnp.float32
+    s = jnp.einsum("hqd,hkd->hqk", qc, kb,
+                   preferred_element_type=f32) * scale
+    p = jnp.exp(s - Lc[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dp = jnp.einsum("hqd,hkd->hqk", doc, vb, preferred_element_type=f32)
+    t = p * (dp - Dc[..., None])
+    return (
+        scale * jnp.einsum("hqk,hkd->hqd", t, kb,
+                           preferred_element_type=f32),
+        scale * jnp.einsum("hqk,hqd->hkd", t, qc,
+                           preferred_element_type=f32),
+        jnp.einsum("hqk,hqd->hkd", p, doc, preferred_element_type=f32),
+    )
+
+
+def _ring_flash_bwd(axis: str, causal: bool, res, do):
+    """Ring flash backward: O(seq·d/p) residuals on the sharded path.
+
+    K/V blocks make a second trip around the ring, each carrying its own
+    ``(dk, dv)`` accumulator: at every hop the local device recomputes the
+    block's probabilities from the saved logsumexp (``p = exp(s - L)``),
+    folds the block's contribution into its local ``dq`` and into the
+    travelling accumulators, and forwards all four. After ``p`` rotations
+    the accumulators are back on their home shard having collected every
+    device's contribution — the gradient analogue of the forward's
+    rotate-and-fold, same ``ppermute`` fabric, no gather. Per block the
+    arithmetic matches ``_flash_chunked_bwd``:
+
+        p  = exp(s - L)            (recomputed, causal-masked)
+        D  = rowsum(do * o)
+        dv += pᵀ do ;  t = p∘(do vᵀ - D)
+        dq += scale · t k ;  dk += scale · tᵀ q
+
+    Causal hop skipping mirrors the forward (blocks with src > idx are
+    never computed); the ``ppermute``s stay unconditional and outside the
+    per-device ``cond`` — a collective inside a branch would deadlock the
+    ring. GQA runs in the same folded layout as the forward: ``dk``/``dv``
+    come out group-summed, ``dq`` is unfolded at the end.
+    """
+    q, k, v, o, L = res
+    p = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    nl, d = q.shape[1:]
+    hkv = k.shape[0]
+    g = q.shape[0] // hkv
+    scale = 1.0 / math.sqrt(d)
+    f32 = jnp.float32
+    perm = ring_perm(p, 1)
+
+    q32 = _fold_groups(q.astype(f32), hkv, g)
+    do32 = _fold_groups(do.astype(f32), hkv, g)
+    o32 = _fold_groups(o.astype(f32), hkv, g)
+    D = jnp.sum(do32 * o32, axis=-1)  # (hkv, nl*g)
+    Lf = L
+
+    # Same q-chunking decision as the forward; padded rows carry
+    # L = -_NEG (huge) so their recomputed p underflows to 0 — they
+    # contribute nothing to dk/dv and their dq rows are sliced off.
+    chunked = nl > _Q_CHUNK
+    nc = -(-nl // _Q_CHUNK)
+    nlp = nc * _Q_CHUNK if chunked else nl
+    cg = _Q_CHUNK * g
+    if chunked and nlp != nl:
+        rows = (nlp - nl) * g
+        q32 = jnp.pad(q32, ((0, 0), (0, rows), (0, 0)))
+        do32 = jnp.pad(do32, ((0, 0), (0, rows), (0, 0)))
+        D = jnp.pad(D, ((0, 0), (0, rows)))
+        Lf = jnp.pad(Lf, ((0, 0), (0, rows)), constant_values=-_NEG)
+
+    def block_grads(qc, doc, Lc, Dc, qpos, kpos, kb32, vb32):
+        mask = _mask_from_pos(qpos, kpos, None, causal)
+        return _flash_block_grads(qc, doc, Lc, Dc, kb32, vb32, mask, scale)
+
+    def contribution(args):
+        j, kb, vb = args
+        src = (idx - j) % p
+        kpos = src * nl + jnp.arange(nl)
+        kb32, vb32 = kb.astype(f32), vb.astype(f32)
+        if not chunked:
+            qpos = idx * nl + jnp.arange(nl * g) // g
+            return block_grads(q32, do32, Lf, D, qpos, kpos, kb32, vb32)
+
+        def body(carry, xs):
+            dka, dva = carry
+            qc, doc, Lc, Dc, ci = xs
+            qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(cg) // g
+            dqc, dkc, dvc = block_grads(qc, doc, Lc, Dc, qpos, kpos,
+                                        kb32, vb32)
+            return (dka + dkc, dva + dvc), dqc
+
+        z = jnp.zeros((hkv, nl, d), f32)
+        (dkj, dvj), dqs = lax.scan(
+            body, (z, z),
+            (_chunk(q32, nc, cg), _chunk(do32, nc, cg),
+             _chunk(Lf, nc, cg), _chunk(D, nc, cg), jnp.arange(nc)))
+        return _unchunk(dqs), dkj, dvj
+
+    nrows = q32.shape[1]
+
+    def skipped(args):
+        return (jnp.zeros((hkv, nrows, d), f32),
+                jnp.zeros((hkv, nl, d), f32),
+                jnp.zeros((hkv, nl, d), f32))
+
+    def contribute(j, kb, vb):
+        if not causal:
+            return contribution((j, kb, vb))
+        return lax.cond((idx - j) % p <= idx, contribution, skipped,
+                        (j, kb, vb))
+
+    def hop(j, carry):
+        dq, kb, vb, dkb, dvb = carry
+        # Prefetch the next K/V pair before the fold (the forward's
+        # double-buffering); the accumulator permutes necessarily wait
+        # on the fold's contribution.
+        kb_next = lax.ppermute(kb, axis, perm)
+        vb_next = lax.ppermute(vb, axis, perm)
+        dqj, dkj, dvj = contribute(j, kb, vb)
+        dkb = lax.ppermute(dkb + dkj, axis, perm)
+        dvb = lax.ppermute(dvb + dvj, axis, perm)
+        return dq + dqj, kb_next, vb_next, dkb, dvb
+
+    z = jnp.zeros((hkv, nl, d), f32)
+    dq, kb, vb, dkb, dvb = lax.fori_loop(
+        0, p - 1, hop, (jnp.zeros((hkv, nrows, d), f32), k, v, z, z))
+    # Last block: contribute, then one final accumulator rotation (the
+    # p-th) lands every (dk, dv) back on its home shard; kb/vb need no
+    # trailing transfer.
+    dqj, dkj, dvj = contribute(p - 1, kb, vb)
+    dq = dq + dqj
+    dk = lax.ppermute(dkb + dkj, axis, perm)
+    dv = lax.ppermute(dvb + dvj, axis, perm)
+    dq = _unfold_groups(dq[:, : nl * g], hkv, g).astype(q.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
@@ -431,12 +611,6 @@ def _flash_chunked_bwd(causal: bool, res, do):
     ar = jnp.arange(c)
     rep = jnp.arange(cg) // g  # folded row -> within-chunk position
 
-    def probs(qc, kb, Lc, ci, kj):
-        s = jnp.einsum("hqd,hkd->hqk", qc, kb,
-                       preferred_element_type=f32) * scale
-        mask = _mask_from_pos(ci * c + rep, kj * c + ar, n, causal)
-        return jnp.where(mask, jnp.exp(s - Lc[..., None]), 0.0)
-
     # ONE pass over the allowed (i, j) block triangle: each block's
     # recomputed p and dp feed dq, dk AND dv together (5 matmuls/block —
     # the separate dq and dk/dv passes each redid s and dp, 7 total).
@@ -451,20 +625,10 @@ def _flash_chunked_bwd(causal: bool, res, do):
             kb, vb, kj = ys
 
             def upd(_):
-                p = probs(qc, kb, Lc, ci, kj)
-                dp = jnp.einsum("hqd,hkd->hqk", doc, vb,
-                                preferred_element_type=f32)
-                t = p * (dp - Dc[..., None])
-                # Folded q rows carry all g groups: the dk/dv einsums
-                # sum the group contributions into the hkv kv heads.
-                return (
-                    scale * jnp.einsum("hqk,hkd->hqd", t, kb,
-                                       preferred_element_type=f32),
-                    scale * jnp.einsum("hqk,hqd->hkd", t, qc,
-                                       preferred_element_type=f32),
-                    jnp.einsum("hqk,hqd->hkd", p, doc,
-                               preferred_element_type=f32),
-                )
+                mask = _mask_from_pos(ci * c + rep, kj * c + ar, n,
+                                      causal)
+                return _flash_block_grads(qc, doc, Lc, Dc, kb, vb, mask,
+                                          scale)
 
             # Only the small per-block contributions pass through the
             # causal-skip cond; the O(seq) accumulators stay pure scan

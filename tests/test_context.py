@@ -387,6 +387,34 @@ def test_ring_backward_no_mask_residuals(rng, sp_mesh):
             f"stacked mask boolean [{m}] in the ring-backward jaxpr")
 
 
+def test_ring_flash_backward_residuals_bounded(rng, sp_mesh):
+    """The ring backward's memory contract: custom_vjp residuals are
+    (q, k, v, o, logsumexp) per shard and the backward recomputes one
+    (h, n_local, n_local) block at a time while counter-rotating K/V —
+    so NO intermediate in the sharded grad jaxpr may exceed one block
+    (= here also the global input size). A hop-stacked residual
+    (p, h, nl, nl) — what remat-autodiff used to linearise out of the
+    fori_loop — is an order of magnitude over the bound."""
+    import re
+    from functools import reduce
+
+    h, n, d = 2, 512, 8
+    nl = n // 8
+    q, k, v = _qkv(rng, h, n, d)
+
+    def loss(q_, k_, v_):
+        return jnp.sum(ring_attention(q_, k_, v_, mesh=sp_mesh,
+                                      causal=True) ** 2)
+
+    s = str(jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v))
+    block_elems = h * nl * nl
+    for m in set(re.findall(r"(?:f32|f16|bf16|bool|pred)\[([0-9,]+)\]", s)):
+        dims = [int(x) for x in m.split(",") if x]
+        assert reduce(lambda a, b: a * b, dims, 1) <= block_elems, (
+            f"intermediate [{m}] exceeds one score block in the ring "
+            "flash-backward jaxpr")
+
+
 def test_ulysses_chunked_grad_parity(rng, sp_mesh, small_chunks):
     """The flash backward through shard_map + all_to_all (the Ulysses
     training path)."""
