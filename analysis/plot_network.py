@@ -49,8 +49,7 @@ def main(argv=None) -> int:
         label = os.path.basename(path)
         ax_t.loglog(sizes, times, marker="o", label=label)
         ax_bw.loglog(sizes, sizes / times, marker="o", label=label)
-        alpha, bw = fit_alpha_beta(rows)
-        print(f"{label}: alpha={alpha:.3f}us bandwidth={bw:.1f}MB/s")
+        print(f"{label}: {fit_alpha_beta(rows).render()}")
     ax_t.set_xlabel("message size [B]")
     ax_t.set_ylabel("time per hop [µs]")
     ax_bw.set_xlabel("message size [B]")

@@ -39,9 +39,7 @@ def main(argv=None) -> int:
         if args.out:
             fabric.write_csv(args.out, rows)
         if args.fit:
-            alpha, bw = fabric.fit_alpha_beta(rows)
-            print(f"alpha={alpha:.3f}us bandwidth={bw:.1f}MB/s",
-                  file=sys.stderr)
+            print(fabric.fit_alpha_beta(rows).render(), file=sys.stderr)
     return 0
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import time
+from typing import NamedTuple
 
 import numpy as np
 
@@ -100,15 +101,42 @@ def write_csv(path: str, rows: list[tuple[int, float]]) -> None:
             fd.write(f"{s},{us:.6f}\n")
 
 
-def fit_alpha_beta(rows: list[tuple[int, float]]) -> tuple[float, float]:
+class Fit(NamedTuple):
+    """α+βn fit result with its quality: consumers must be able to tell a
+    measured bandwidth from fit noise (a loopback Gloo probe once shipped
+    an artifact reading "infinite bandwidth" off a β ≤ 0 slope)."""
+
+    alpha_us: float
+    bandwidth_mb_s: float  # math.inf when unidentifiable — check the flag
+    r2: float  # of the unconstrained linear fit
+    identifiable: bool  # False when β ≤ 0 (noise-dominated probe)
+
+    def render(self) -> str:
+        """The one rendering every consumer (CLI stderr, fit.txt) uses,
+        so artifacts and logs cannot disagree on the flag format."""
+        bw = (f"{self.bandwidth_mb_s:.1f}MB/s" if self.identifiable
+              else "unidentifiable(beta<=0)")
+        return f"alpha={self.alpha_us:.3f}us bandwidth={bw} r2={self.r2:.3f}"
+
+
+def fit_alpha_beta(rows: list[tuple[int, float]]) -> Fit:
     """Linear model t = α + β·n over the probe rows (times in µs).
 
-    Returns ``(alpha_us, bandwidth_mb_s)`` — the latency intercept and the
-    1/β asymptotic bandwidth, as in the reference's ``plot.ipynb`` cell 5
-    ``np.polyfit(buffer_size, time, 1)`` fit.
+    Returns :class:`Fit` — the latency intercept ``alpha_us`` and the 1/β
+    asymptotic bandwidth, as in the reference's ``plot.ipynb`` cell 5
+    ``np.polyfit(buffer_size, time, 1)`` fit, plus the fit's R² and an
+    ``identifiable`` flag. A noise-dominated probe can fit β ≤ 0 (observed
+    on loopback Gloo): β is then clamped to 0 — α degrades to the mean
+    latency, bandwidth is reported as ``inf`` with ``identifiable=False``,
+    and renderers should print the flag, not the number.
     """
     sizes = np.array([r[0] for r in rows], dtype=np.float64)
     times = np.array([r[1] for r in rows], dtype=np.float64)
     beta, alpha = np.polyfit(sizes, times, 1)
-    bandwidth_mb_s = (1.0 / beta) if beta > 0 else float("inf")
-    return float(alpha), float(bandwidth_mb_s)
+    ss_tot = float(((times - times.mean()) ** 2).sum())
+    ss_res = float(((times - (alpha + beta * sizes)) ** 2).sum())
+    r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
+    if beta <= 0:
+        # Constrained refit with β = 0: the best constant model.
+        return Fit(float(times.mean()), float("inf"), r2, False)
+    return Fit(float(alpha), float(1.0 / beta), r2, True)

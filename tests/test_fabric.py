@@ -33,6 +33,24 @@ def test_sweep_schema_and_csv(tmp_path):
 def test_fit_alpha_beta_recovers_model():
     # Synthetic t = 2.5 + 0.001*n (alpha 2.5us, bandwidth 1000 MB/s).
     rows = [(n, 2.5 + 0.001 * n) for n in (1, 10, 100, 1000, 10**4, 10**5, 10**6)]
-    alpha, bw = fabric.fit_alpha_beta(rows)
-    assert alpha == pytest.approx(2.5, rel=1e-6)
-    assert bw == pytest.approx(1000.0, rel=1e-6)
+    fit = fabric.fit_alpha_beta(rows)
+    assert fit.alpha_us == pytest.approx(2.5, rel=1e-6)
+    assert fit.bandwidth_mb_s == pytest.approx(1000.0, rel=1e-6)
+    assert fit.identifiable
+    assert fit.r2 == pytest.approx(1.0, abs=1e-9)
+
+
+def test_fit_alpha_beta_noise_dominated_flagged():
+    """A β ≤ 0 slope (noise-dominated probe, seen on loopback Gloo) must
+    come back flagged unidentifiable — with α degraded to the mean
+    latency — instead of a numeric "infinite bandwidth"."""
+    import math
+
+    rows = [(1, 3200.0), (10, 3100.0), (100, 3300.0), (1000, 3150.0),
+            (10**4, 3250.0), (10**5, 3050.0), (10**6, 3000.0)]
+    fit = fabric.fit_alpha_beta(rows)
+    assert not fit.identifiable
+    assert math.isinf(fit.bandwidth_mb_s)
+    assert fit.alpha_us == pytest.approx(
+        sum(t for _, t in rows) / len(rows))
+    assert fit.r2 < 0.9
