@@ -1,0 +1,57 @@
+"""Quadrature sweep plots: raw times + speedup from a ``times.txt``.
+
+Script form of the reference's ``1-integral/integral_plots.ipynb`` (cells
+1-2, rendering ``integral_plot.png``/``integral_plot_accel.png``): line k
+of the times file is the wall time at k devices/ranks; render the raw
+times and the speedup ``T1/TN`` as scatter plus dashed line. Works on
+reference-produced (``integral_out.txt``, ``times.txt`` — gtime error
+lines skipped) and TPU-produced times files alike.
+
+Usage: ``python analysis/plot_integral.py [times.txt] [out_prefix]``
+writes ``<out_prefix>.png`` (times) and ``<out_prefix>_accel.png``
+(speedup); the default prefix is ``integral_plot``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+from plot_life import load_times, plot_speedup  # noqa: E402
+# (same times.txt dialect and the same T1/TN rendering)
+
+
+def plot_times(times: np.ndarray, out: str) -> None:
+    n = np.arange(1, len(times) + 1)
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.scatter(n, times, zorder=3)
+    ax.plot(n, times, linestyle="--", zorder=2)
+    ax.set_xlabel("devices")
+    ax.set_ylabel("wall time [s]")
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    times_path = argv[0] if argv else "times.txt"
+    prefix = argv[1] if len(argv) > 1 else "integral_plot"
+    times = load_times(times_path)
+    if len(times) == 0:
+        print(f"{times_path}: no parsable times", file=sys.stderr)
+        return 1
+    plot_times(times, f"{prefix}.png")
+    plot_speedup(times, f"{prefix}_accel.png")
+    print(f"{prefix}.png")
+    print(f"{prefix}_accel.png")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,7 +95,26 @@ def test_plot_network_script(tmp_path, monkeypatch, capsys):
     rc = plot_network.main([str(csv)])
     assert rc == 0
     assert (tmp_path / "network_params.png").exists()
-    assert "alpha=" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "alpha=" in out and "r2=" in out
+
+
+def test_plot_integral_script(tmp_path):
+    """Integral speedup analog of the reference's integral_plots.ipynb
+    cells 1-2: raw times + T1/TN accel PNGs from a times file, tolerant
+    of gtime error lines."""
+    times = tmp_path / "integral_out.txt"
+    times.write_text(
+        "120.4\n61.0\nCommand exited with non-zero status 1\n31.2\n")
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import plot_integral
+
+    prefix = tmp_path / "integral_plot"
+    rc = plot_integral.main([str(times), str(prefix)])
+    assert rc == 0
+    for suffix in (".png", "_accel.png"):
+        p = tmp_path / f"integral_plot{suffix}"
+        assert p.exists() and p.stat().st_size > 1000
 
 
 def test_plot_bigboard_script(tmp_path):
