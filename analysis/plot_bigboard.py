@@ -54,10 +54,10 @@ def main(argv) -> int:
     fig.patch.set_facecolor(SURFACE)
     ax.set_facecolor(SURFACE)
     ax.plot(ns, gc, color=TEXT_2, lw=1.2, zorder=1, alpha=0.5)
-    seen = []
+    seen = set()
     for n, g, p in zip(ns, gc, paths):
         lbl = PATH_LABEL[p] if p not in seen else None
-        seen.append(p)
+        seen.add(p)
         ax.scatter([n], [g], s=52, color=PATH_COLOR[p], label=lbl,
                    zorder=3, edgecolors=SURFACE, linewidths=1.5)
     peak = max(range(len(gc)), key=gc.__getitem__)

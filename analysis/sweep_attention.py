@@ -14,6 +14,10 @@ half). Emits a CSV:
 
     seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced
 
+where `bwd_sec` times one FULL grad step (forward + backward per chain
+link — a backward can't run without its forward) and `bwd_tflops` uses
+the matching fwd+bwd = 3.5x fwd accounting.
+
 Usage: python analysis/sweep_attention.py [--out results/attention/attention_tpu.csv]
 """
 

@@ -227,17 +227,19 @@ def main(argv=None) -> int:
             g_1 = timed(lambda: grad_chain(*qkv, r=1))
             g_3 = timed(lambda: grad_chain(*qkv, r=3))
         except Exception as e:  # never lose the whole bench line to this
-            sharded["attention_bwd_error"] = f"{type(e).__name__}: {e}"[:200]
+            sharded["attention_grad_error"] = f"{type(e).__name__}: {e}"[:200]
         else:
-            bwd_diff = g_3 > g_1
-            bwd_sec = (g_3 - g_1) / 2 if bwd_diff else g_1
+            grad_diff = g_3 > g_1
+            grad_sec = (g_3 - g_1) / 2 if grad_diff else g_1
             sharded.update({
-                # fwd+bwd = 3.5x the fwd FLOPs (bwd = 5 block matmuls
-                # vs 2).
-                "attention_32k_bwd_sec": round(bwd_sec, 5),
-                "attention_32k_bwd_tflops": round(
-                    3.5 * flops / bwd_sec / 1e12, 1),
-                "attention_bwd_is_differenced": bwd_diff,
+                # grad_sec times one FULL grad step (forward + backward
+                # per chain link — a backward can't run without its
+                # forward); TFLOP/s uses the matching fwd+bwd = 3.5x fwd
+                # accounting (bwd = 5 block matmuls vs 2).
+                "attention_32k_grad_sec": round(grad_sec, 5),
+                "attention_32k_grad_tflops": round(
+                    3.5 * flops / grad_sec / 1e12, 1),
+                "attention_grad_is_differenced": grad_diff,
             })
     print(json.dumps({
         "metric": "life_steady_cups_p46gun_big",

@@ -31,10 +31,9 @@ def main(argv=None) -> int:
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--causal", action="store_true")
     p.add_argument("--grad", action="store_true",
-                   help="time the backward pass too (the chunked path "
-                   "takes the flash custom_vjp backward, O(seq*d) "
-                   "residuals; the multi-device ring remats its block "
-                   "updates)")
+                   help="time the backward pass too (both the chunked "
+                   "path and the multi-device ring take a flash "
+                   "custom_vjp backward, O(seq*d) residuals)")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="GQA/MQA: fewer K/V heads than query heads")
     p.add_argument("--devices", type=int, default=None,
@@ -54,6 +53,10 @@ def main(argv=None) -> int:
     from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
 
     if args.variant == "flash":
+        if args.devices not in (None, 1):
+            p.error(f"--variant flash is single-device; --devices "
+                    f"{args.devices} would be silently ignored (use "
+                    "--variant ring/ulysses for a sharded run)")
         mesh = mesh_lib.make_mesh_1d(1, axis=context.AXIS_SP)  # size only
 
         def fn(q, k, v, mesh=None, causal=False):
