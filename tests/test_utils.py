@@ -52,6 +52,35 @@ def test_vtk_roundtrip(tmp_path, make_board):
     assert f"CELL_DATA {9 * 14}" in text
 
 
+def test_vtk_golden_file(tmp_path):
+    """Committed golden frame (the in-repo mirror of the reference's
+    `4-life/vtk/life_000000.vtk` artifact): the writer's byte-level
+    output for the glider fixture is pinned, so any format drift —
+    header, ordering, line endings — fails here even when the reference
+    tree is absent. Both writers (Python and, when built, the native
+    C++ one) must reproduce it exactly, and the reader must invert it."""
+    golden = os.path.join(FIXTURES, "golden_glider_000000.vtk")
+    cfg = load_config_py(os.path.join(FIXTURES, "glider_10x10.cfg"))
+    np.testing.assert_array_equal(read_vtk(golden), cfg.board())
+
+    ours = tmp_path / "life_000000.vtk"
+    write_vtk_py(ours, cfg.board())
+    assert ours.read_text() == open(golden).read()
+
+    from mpi_and_open_mp_tpu.utils import native
+
+    if native.available():
+        theirs = tmp_path / "life_native.vtk"
+        native.write_vtk(theirs, cfg.board())
+        got = theirs.read_text().splitlines()
+        want = open(golden).read().splitlines()
+        assert len(got) == len(want)
+        for i, (g, w) in enumerate(zip(got, want)):
+            if i == 1:  # creator comment line may differ
+                continue
+            assert g == w, f"line {i}: {g!r} != {w!r}"
+
+
 @pytest.mark.parametrize("n,p", [(500, 8), (10, 3), (28, 28), (7, 2), (100, 1)])
 def test_decomposition_reference_semantics(n, p):
     """Floor chunks, last shard absorbs the remainder (3-life/life_mpi.c:178-183)."""
