@@ -55,10 +55,12 @@ def main(argv) -> int:
     ax.set_ylim(0, max(fwd + [t for _, t in bwd]) * 1.2)
     ax.set_xlabel("sequence length (tokens)", color=TEXT, fontsize=9)
     ax.set_ylabel("achieved TFLOP/s (one chip)", color=TEXT, fontsize=9)
+    engines = sorted({r.get("engine", "") for r in rows} - {"", None})
+    eng = f"engine: {'/'.join(engines)}; " if engines else ""
     ax.set_title(
-        "Causal flash-chunked attention scaling, bf16, 8 heads × d=128\n"
-        "(marginal per-call, RTT-differenced; fwd+bwd = 3.5× fwd FLOP "
-        "accounting)",
+        "Causal flash attention scaling, bf16, 8 heads × d=128\n"
+        f"({eng}marginal per-call, RTT-differenced; fwd+bwd = 3.5× "
+        "fwd FLOP accounting)",
         color=TEXT, fontsize=9.5,
     )
     ax.grid(axis="y", color=GRID, lw=0.7, zorder=0)

@@ -12,11 +12,13 @@ next call's queries so the chain cannot be elided — and difference a
 longer chain (R=9 fwd, R=3 bwd) against R=1, best-of-3 each. TFLOP/s counts 2*h*n^2*d (QK^T + PV, causal
 half). Emits a CSV:
 
-    seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced
+    seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine
 
 where `bwd_sec` times one FULL grad step (forward + backward per chain
-link — a backward can't run without its forward) and `bwd_tflops` uses
-the matching fwd+bwd = 3.5x fwd accounting.
+link — a backward can't run without its forward), `bwd_tflops` uses
+the matching fwd+bwd = 3.5x fwd accounting, and `engine` records which
+attention engine (pallas kernel / jnp chunked) produced the row — a
+mid-sweep fallback is visible in the artifact.
 
 Usage: python analysis/sweep_attention.py [--out results/attention/attention_tpu.csv]
 """
@@ -43,6 +45,10 @@ def main(argv=None) -> int:
                     default=[8192, 16384, 32768, 65536, 131072])
     ap.add_argument("--bwd-max", type=int, default=65536,
                     help="longest sequence to also time the backward at")
+    ap.add_argument("--engine", choices=("auto", "jnp"), default="auto",
+                    help="auto = let flash_attention dispatch to the "
+                    "bundled Pallas TPU kernel on eligible shapes; jnp "
+                    "= force the chunked XLA engine")
     args = ap.parse_args(argv)
 
     import jax
@@ -53,27 +59,60 @@ def main(argv=None) -> int:
         print("refusing to record: backend is not TPU", file=sys.stderr)
         return 1
 
+    from mpi_and_open_mp_tpu.parallel import context
     from mpi_and_open_mp_tpu.parallel.context import (
         attention_reference, flash_attention)
     from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
+    if args.engine == "jnp":
+        context.disable_tpu_flash()
+
     rng = np.random.default_rng(0)
+
+    def force_jnp(why: str) -> None:
+        context.disable_tpu_flash()
+        print(f"pallas engine disabled ({why}); jnp engine takes over",
+              file=sys.stderr)
 
     # Honesty gate: the timed kernel must match the dense oracle first.
     # Pinned to full-precision matmuls — the default TPU float32 matmul
     # takes bf16 MXU passes, whose rounding would swamp the algorithmic
     # tolerance being checked (the timed runs below use the default, which
-    # IS the production bf16 configuration).
+    # IS the production bf16 configuration). If the Pallas engine fails
+    # the gate (or fails to compile through this stack), fall back to the
+    # jnp engine rather than losing the chip window — each engine must
+    # pass the same gate before its timings are recorded (gated() below
+    # re-runs the gate on every engine flip, including mid-sweep).
     n0 = 2048
     q0, k0, v0 = (jnp.asarray(rng.standard_normal((HEADS, n0, DIM)),
                               jnp.float32) for _ in range(3))
-    with jax.default_matmul_precision("highest"):
-        got = flash_attention(q0, k0, v0, causal=True)
-        want = attention_reference(q0, k0, v0, causal=True)
-    if not np.allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
-                       atol=2e-4):
+
+    def gate() -> bool:
+        with jax.default_matmul_precision("highest"):
+            got = flash_attention(q0, k0, v0, causal=True)
+            want = attention_reference(q0, k0, v0, causal=True)
+        return bool(np.allclose(np.asarray(got), np.asarray(want),
+                                rtol=2e-4, atol=2e-4))
+
+    def gated() -> bool:
+        """Gate the CURRENT engine; on a Pallas failure fall back to jnp
+        and gate that instead. False = no engine passes."""
+        try:
+            ok = gate()
+        except Exception as e:
+            if not context._TPU_FLASH:
+                raise
+            force_jnp(f"{type(e).__name__} in parity gate")
+            return gate()
+        if not ok and context._TPU_FLASH:
+            force_jnp("parity gate failed")
+            return gate()
+        return ok
+
+    if not gated():
         print("parity check failed; not recording", file=sys.stderr)
         return 1
+    print(f"engine: {context.tpu_flash_engine()}", file=sys.stderr)
 
     @functools.partial(jax.jit, static_argnames=("r",))
     def fwd_chain(q, k, v, r):
@@ -123,25 +162,44 @@ def main(argv=None) -> int:
             return (t2 - t1) / (r2 - 1), True
         return t1, False
 
-    rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced"]
+    rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine"]
     for n in args.seqs:
         qkv = tuple(jnp.asarray(rng.standard_normal((HEADS, n, DIM)),
                                 jnp.bfloat16) for _ in range(3))
         flops = 2 * HEADS * n * n * DIM
-        fwd, diff_f = marginal(fwd_chain, qkv)
-        if n <= args.bwd_max:
-            # grad runs fwd + bwd; standard fwd+bwd accounting is 3.5x the
-            # fwd FLOPs (bwd = 2.5x: 5 block matmuls vs 2). The flash
-            # backward's score recompute is NOT counted — achieved
-            # useful-FLOP/s only.
-            bwd, diff_b = marginal(bwd_chain, qkv, r2=3)
-            bwd_s, bwd_t = f"{bwd:.5f}", f"{3.5 * flops / bwd / 1e12:.1f}"
-            diff = diff_f and diff_b
-        else:
-            bwd_s = bwd_t = ""
-            diff = diff_f
-        rows.append(f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},"
-                    f"{bwd_s},{bwd_t},{int(diff)}")
+
+        def point():
+            # Engine recorded per row: a mid-sweep fallback must be
+            # visible in the artifact, not only on stderr.
+            engine = context.tpu_flash_engine()
+            fwd, diff_f = marginal(fwd_chain, qkv)
+            if n <= args.bwd_max:
+                # grad runs fwd + bwd; standard fwd+bwd accounting is
+                # 3.5x the fwd FLOPs (bwd = 2.5x: 5 block matmuls vs 2).
+                # The flash backward's score recompute is NOT counted —
+                # achieved useful-FLOP/s only.
+                bwd, diff_b = marginal(bwd_chain, qkv, r2=3)
+                return (f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},"
+                        f"{bwd:.5f},{3.5 * flops / bwd / 1e12:.1f},"
+                        f"{int(diff_f and diff_b)},{engine}")
+            return (f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},,,"
+                    f"{int(diff_f)},{engine}")
+
+        try:
+            rows.append(point())
+        except Exception as e:
+            # A shape the Pallas kernel won't take through this stack
+            # (VMEM, Mosaic) must not lose the whole sweep: fall back to
+            # the jnp engine — re-gated before anything is recorded —
+            # for this and later points.
+            if not context._TPU_FLASH:
+                raise
+            force_jnp(f"{type(e).__name__} at seq {n}")
+            if not gated():
+                print("jnp engine failed the parity gate after fallback;"
+                      " not recording further", file=sys.stderr)
+                return 1
+            rows.append(point())
         print(rows[-1], flush=True)
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)

@@ -170,8 +170,48 @@ def main(argv=None) -> int:
         import jax.numpy as jnp
         from jax import lax as jlax
 
-        from mpi_and_open_mp_tpu.parallel.context import flash_attention
+        from mpi_and_open_mp_tpu.parallel import context
+        from mpi_and_open_mp_tpu.parallel.context import (
+            attention_reference, flash_attention)
         from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+        # Same honesty gate as sweep_attention: whichever engine
+        # flash_attention dispatches to (Pallas kernel on TPU, jnp
+        # otherwise) must match the dense oracle before its timings are
+        # recorded; on failure fall back to the jnp engine.
+        n0 = 2048
+        gq, gk, gv = (jnp.asarray(rng.standard_normal((8, n0, 128)),
+                                  jnp.float32) for _ in range(3))
+
+        def attn_gate():
+            with jax.default_matmul_precision("highest"):
+                got = flash_attention(gq, gk, gv, causal=True)
+                want = attention_reference(gq, gk, gv, causal=True)
+            return bool(np.allclose(np.asarray(got), np.asarray(want),
+                                    rtol=2e-4, atol=2e-4))
+
+        gate_notes = []
+        try:
+            attn_ok = attn_gate()
+            if not attn_ok:
+                gate_notes.append(
+                    f"{context.tpu_flash_engine()} engine failed parity")
+        except Exception as e:
+            attn_ok = False
+            gate_notes.append(f"{context.tpu_flash_engine()} engine: "
+                              f"{type(e).__name__}: {e}"[:160])
+        if not attn_ok and context._TPU_FLASH:
+            context.disable_tpu_flash()
+            try:
+                attn_ok = attn_gate()
+                if not attn_ok:
+                    gate_notes.append("jnp engine failed parity")
+            except Exception as e:  # keep the bench line alive
+                gate_notes.append(
+                    f"jnp engine: {type(e).__name__}: {e}"[:160])
+        sharded["attention_engine"] = context.tpu_flash_engine()
+        if not attn_ok:
+            sharded["attention_error"] = "; ".join(gate_notes)
 
         h, n, d = 8, 32 * 1024, 128
         qkv = [jnp.asarray(rng.standard_normal((h, n, d)), jnp.bfloat16)
@@ -191,20 +231,23 @@ def main(argv=None) -> int:
                 best_r = min(best_r, time.perf_counter() - t0)
             return best_r
 
-        anchor_sync(chain(*qkv, jnp.int32(1)), fetch_all=True)  # compile
-        t_1 = timed(lambda: chain(*qkv, jnp.int32(1)))
-        t_9 = timed(lambda: chain(*qkv, jnp.int32(9)))
-        # Same anomaly discipline as measure(): if jitter made the longer
-        # chain "faster", report the end-to-end single call un-differenced
-        # and flag it, rather than emitting a nonsense marginal rate.
-        attn_diff = t_9 > t_1
-        attn_sec = (t_9 - t_1) / 8 if attn_diff else t_1
-        flops = 2 * h * n * n * d  # QK^T + PV, causal half
-        sharded.update({
-            "attention_32k_causal_sec": round(attn_sec, 5),
-            "attention_32k_causal_tflops": round(flops / attn_sec / 1e12, 1),
-            "attention_is_differenced": attn_diff,
-        })
+        if attn_ok:
+            anchor_sync(chain(*qkv, jnp.int32(1)), fetch_all=True)  # compile
+            t_1 = timed(lambda: chain(*qkv, jnp.int32(1)))
+            t_9 = timed(lambda: chain(*qkv, jnp.int32(9)))
+            # Same anomaly discipline as measure(): if jitter made the
+            # longer chain "faster", report the end-to-end single call
+            # un-differenced and flag it, rather than emitting a nonsense
+            # marginal rate.
+            attn_diff = t_9 > t_1
+            attn_sec = (t_9 - t_1) / 8 if attn_diff else t_1
+            flops = 2 * h * n * n * d  # QK^T + PV, causal half
+            sharded.update({
+                "attention_32k_causal_sec": round(attn_sec, 5),
+                "attention_32k_causal_tflops": round(
+                    flops / attn_sec / 1e12, 1),
+                "attention_is_differenced": attn_diff,
+            })
 
         # Training path: the flash custom_vjp backward, FULL (q, k, v)
         # gradients — grad wrt q alone lets XLA prune the dk+dv pass and
@@ -222,6 +265,8 @@ def main(argv=None) -> int:
             return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
         try:
+            if not attn_ok:
+                raise RuntimeError("attention parity gate failed")
             anchor_sync(grad_chain(*qkv, r=1), fetch_all=True)  # compile
             anchor_sync(grad_chain(*qkv, r=3), fetch_all=True)
             g_1 = timed(lambda: grad_chain(*qkv, r=1))

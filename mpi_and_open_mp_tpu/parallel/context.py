@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -425,8 +426,72 @@ def _ring_flash_bwd(axis: str, causal: bool, res, do):
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
+# On-TPU the single-device engine can dispatch to jax's bundled Pallas
+# flash-attention kernel (block-pipelined HBM->VMEM, MXU-shaped tiles)
+# instead of the jnp-chunked path, which tops out around 25% MFU as pure
+# XLA. The jnp path remains the CPU/interpret oracle and the fallback
+# for shapes the kernel doesn't take. MOMP_TPU_FLASH=0 forces the jnp
+# engine everywhere (and the sweep's parity gate flips this off at
+# runtime if the kernel ever disagrees with the dense oracle).
+_TPU_FLASH = os.environ.get("MOMP_TPU_FLASH", "1") != "0"
+
+
+def tpu_flash_engine() -> str:
+    """Which engine ``flash_attention`` will dispatch eligible shapes to
+    — ``"pallas"`` or ``"jnp"`` — for recorders' provenance fields."""
+    return "pallas" if _TPU_FLASH else "jnp"
+
+
+def disable_tpu_flash() -> None:
+    """Force the jnp engine from here on (recorders call this when the
+    Pallas kernel fails a parity gate or fails to compile). Drops jit
+    caches too: already-compiled callers would otherwise keep
+    dispatching to the Pallas kernel, making the flip silently a no-op.
+    """
+    global _TPU_FLASH
+    _TPU_FLASH = False
+    jax.clear_caches()
+
+
+def _pallas_flash_eligible(q, k, v) -> bool:
+    """Static (trace-time) routing predicate for the bundled Pallas TPU
+    kernel: TPU backend, no GQA folding (the kernel wants equal head
+    counts; our folded jnp path is the better GQA engine anyway),
+    128-multiple sequence (the kernel's default block), MXU-width head
+    dim, and a dtype the MXU takes directly."""
+    if not _TPU_FLASH:
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except RuntimeError:  # no backend at all (early init)
+        return False
+    h, n, d = q.shape
+    return (k.shape[0] == h and n % 128 == 0 and d % 128 == 0
+            and q.dtype in (jnp.float32, jnp.bfloat16)
+            and k.dtype == q.dtype and v.dtype == q.dtype)
+
+
+def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:
+    """Dispatch one (heads, seq, d) attention to the bundled Pallas TPU
+    flash kernel (batch dim added/stripped; same 1/sqrt(d) scaling as
+    ``attention_reference``). Differentiable via the kernel's own
+    flash custom_vjp."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    out = fa.flash_attention(
+        q[None], k[None], v[None], causal=causal,
+        sm_scale=1.0 / math.sqrt(q.shape[-1]))
+    return out[0].astype(q.dtype)
+
+
 def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
     """Full local attention, flash-style double chunking (exact softmax).
+
+    On a TPU backend, shapes the bundled Pallas flash kernel takes are
+    dispatched to it (:func:`_pallas_flash_eligible`); everything below
+    describes the jnp engine that carries every other case and is the
+    CPU/interpret oracle.
 
     Scans q AND k/v in ``_Q_CHUNK`` slices so only a ``(h, _Q_CHUNK,
     _Q_CHUNK)`` score block is ever live; causal k blocks entirely in a q
@@ -460,6 +525,8 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
     if n <= _Q_CHUNK:
         return attention_reference(
             q, *_repeat_heads(k, v, h // k.shape[0]), causal=causal)
+    if _pallas_flash_eligible(q, k, v):
+        return _pallas_flash(q, k, v, causal)
     return _flash_chunked(causal, q, k, v)
 
 
@@ -752,8 +819,11 @@ def flash_attention(
     (one-chip training steps, benches). Exact softmax in O(chunk·seq)
     memory, the flash ``custom_vjp`` backward (O(seq·d) residuals), and
     GQA/MQA K/V heads run un-expanded (query groups fold into the row
-    axis). Shapes ``(heads, seq, head_dim)``; ``k``/``v`` may carry
-    fewer heads as long as they divide ``q``'s."""
+    axis). On TPU, eligible shapes (equal head counts, 128-multiple
+    seq, MXU-width head dim) run jax's bundled Pallas flash kernel;
+    ``MOMP_TPU_FLASH=0`` forces the jnp engine. Shapes ``(heads, seq,
+    head_dim)``; ``k``/``v`` may carry fewer heads as long as they
+    divide ``q``'s."""
     _check_gqa(q, k, v, "flash_attention")
     return _attention_chunked(q, k, v, causal)
 

@@ -479,6 +479,34 @@ def test_flash_attention_public_api(rng, small_chunks):
         flash_attention(q, k3, k3)
 
 
+def test_pallas_dispatch_routing(rng, monkeypatch):
+    """The TPU flash-kernel dispatch predicate: routes only equal-head,
+    128-multiple-seq, MXU-width-dim, matching-float shapes, only on a
+    TPU backend, and only while the engine flag is up — the CPU/oracle
+    path must never see the Pallas kernel."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    def qkv(hq=4, hkv=4, n=1024, d=128, dt=jnp.bfloat16, kdt=None):
+        q = jnp.zeros((hq, n, d), dt)
+        k = jnp.zeros((hkv, n, d), kdt or dt)
+        return q, k, jnp.zeros((hkv, n, d), kdt or dt)
+
+    # On the real (cpu) test backend: never eligible.
+    assert not context._pallas_flash_eligible(*qkv())
+
+    monkeypatch.setattr(context.jax, "default_backend", lambda: "tpu")
+    assert context._pallas_flash_eligible(*qkv())
+    assert not context._pallas_flash_eligible(*qkv(hkv=2))  # GQA -> jnp
+    assert not context._pallas_flash_eligible(*qkv(n=1000))  # seq % 128
+    assert not context._pallas_flash_eligible(*qkv(d=64))  # head dim
+    assert not context._pallas_flash_eligible(
+        *qkv(dt=jnp.float16))  # dtype
+    assert not context._pallas_flash_eligible(
+        *qkv(kdt=jnp.float32))  # mixed dtypes
+    monkeypatch.setattr(context, "_TPU_FLASH", False)
+    assert not context._pallas_flash_eligible(*qkv())  # kill switch
+
+
 def test_ring_attention_default_mesh(rng):
     q, k, v = _qkv(rng, 2, 64, 8)
     got = ring_attention(q, k, v, causal=False)

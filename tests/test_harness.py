@@ -137,9 +137,11 @@ def test_plot_attention_script_with_and_without_bwd(tmp_path):
     import plot_attention
 
     full = tmp_path / "att.csv"
-    full.write_text("seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced\n"
-                    "8192,0.003,48.0,0.010,47.0,1\n"
-                    "16384,0.012,46.0,0.042,45.0,1\n")
+    # New-schema CSV (trailing engine column, possibly mixed mid-sweep).
+    full.write_text(
+        "seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine\n"
+        "8192,0.003,48.0,0.010,47.0,1,pallas\n"
+        "16384,0.012,46.0,0.042,45.0,1,jnp\n")
     out = tmp_path / "att.png"
     rc = plot_attention.main(["plot_attention", str(full), str(out)])
     assert rc == 0 and out.stat().st_size > 1000
