@@ -60,8 +60,7 @@ def main(argv=None) -> int:
         return 1
 
     from mpi_and_open_mp_tpu.parallel import context
-    from mpi_and_open_mp_tpu.parallel.context import (
-        attention_reference, flash_attention)
+    from mpi_and_open_mp_tpu.parallel.context import flash_attention
     from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
     if args.engine == "jnp":
@@ -74,45 +73,19 @@ def main(argv=None) -> int:
         print(f"pallas engine disabled ({why}); jnp engine takes over",
               file=sys.stderr)
 
-    # Honesty gate: the timed kernel must match the dense oracle first.
-    # Pinned to full-precision matmuls — the default TPU float32 matmul
-    # takes bf16 MXU passes, whose rounding would swamp the algorithmic
-    # tolerance being checked (the timed runs below use the default, which
-    # IS the production bf16 configuration). If the Pallas engine fails
-    # the gate (or fails to compile through this stack), fall back to the
-    # jnp engine rather than losing the chip window — each engine must
-    # pass the same gate before its timings are recorded (gated() below
-    # re-runs the gate on every engine flip, including mid-sweep).
-    n0 = 2048
-    q0, k0, v0 = (jnp.asarray(rng.standard_normal((HEADS, n0, DIM)),
-                              jnp.float32) for _ in range(3))
-
-    def gate() -> bool:
-        with jax.default_matmul_precision("highest"):
-            got = flash_attention(q0, k0, v0, causal=True)
-            want = attention_reference(q0, k0, v0, causal=True)
-        return bool(np.allclose(np.asarray(got), np.asarray(want),
-                                rtol=2e-4, atol=2e-4))
-
-    def gated() -> bool:
-        """Gate the CURRENT engine; on a Pallas failure fall back to jnp
-        and gate that instead. False = no engine passes."""
-        try:
-            ok = gate()
-        except Exception as e:
-            if not context._TPU_FLASH:
-                raise
-            force_jnp(f"{type(e).__name__} in parity gate")
-            return gate()
-        if not ok and context._TPU_FLASH:
-            force_jnp("parity gate failed")
-            return gate()
-        return ok
-
-    if not gated():
+    # Honesty gate — shared with bench.py (context.gated_parity_check):
+    # the engine flash_attention dispatches to must match the dense
+    # oracle before any of its timings are recorded, with automatic
+    # fallback (and re-gate) to the jnp engine on a Pallas failure so a
+    # chip window is never lost to a kernel problem. Re-run on every
+    # mid-sweep engine flip too.
+    ok, engine, notes = context.gated_parity_check(HEADS, 2048, DIM)
+    for note in notes:
+        print(note, file=sys.stderr)
+    if not ok:
         print("parity check failed; not recording", file=sys.stderr)
         return 1
-    print(f"engine: {context.tpu_flash_engine()}", file=sys.stderr)
+    print(f"engine: {engine}", file=sys.stderr)
 
     @functools.partial(jax.jit, static_argnames=("r",))
     def fwd_chain(q, k, v, r):
@@ -163,6 +136,16 @@ def main(argv=None) -> int:
         return t1, False
 
     rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine"]
+
+    def flush() -> None:
+        # Written after EVERY point: a mid-sweep crash must not discard
+        # already-gated rows bought with scarce chip time.
+        outdir = os.path.dirname(args.out)
+        if outdir:
+            os.makedirs(outdir, exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write("\n".join(rows) + "\n")
+
     for n in args.seqs:
         qkv = tuple(jnp.asarray(rng.standard_normal((HEADS, n, DIM)),
                                 jnp.bfloat16) for _ in range(3))
@@ -191,20 +174,22 @@ def main(argv=None) -> int:
             # A shape the Pallas kernel won't take through this stack
             # (VMEM, Mosaic) must not lose the whole sweep: fall back to
             # the jnp engine — re-gated before anything is recorded —
-            # for this and later points.
+            # for this and later points. (Already-recorded rows are on
+            # disk either way, via flush().)
             if not context._TPU_FLASH:
                 raise
             force_jnp(f"{type(e).__name__} at seq {n}")
-            if not gated():
+            ok, _, notes = context.gated_parity_check(HEADS, 2048, DIM)
+            for note in notes:
+                print(note, file=sys.stderr)
+            if not ok:
                 print("jnp engine failed the parity gate after fallback;"
                       " not recording further", file=sys.stderr)
                 return 1
             rows.append(point())
+        flush()
         print(rows[-1], flush=True)
 
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        f.write("\n".join(rows) + "\n")
     print(f"wrote {args.out}", file=sys.stderr)
     return 0
 

@@ -35,7 +35,10 @@ Instead of per-rank slabs with in-place ghost writes, the global board is ONE
   mesh the planner (``bitlife.plan_sharded_bits``) accepts — unaligned
   boards (the 500x500 flagship included) live in a word/lane-aligned
   padded frame whose torus wrap is kept exact via periodic mirrors and
-  funnel-shifted wrap halos.
+  funnel-shifted wrap halos. A 1-device mesh has no neighbours, so on
+  TPU it dispatches straight to the serial whole-board stepper (ghost
+  redundancy and exchange rounds buy nothing there); the exchange
+  machinery engages from 2 devices.
 
 ``impl="auto"``: serial boards pick ``pallas`` on TPU / ``roll``
 elsewhere; sharded layouts pick ``bitfused`` on TPU whenever the
@@ -69,6 +72,11 @@ from mpi_and_open_mp_tpu.utils.config import LifeConfig
 
 LAYOUTS = ("serial", "row", "col", "cart")
 IMPLS = ("auto", "roll", "halo", "pallas", "bitfused")
+
+# The bitfused 1-device serial dispatch is TPU-only by default (on CPU
+# the interpret-mode suite keeps exercising the exchange machinery the
+# fast path bypasses); tests flip this to cover the dispatch itself.
+_BITFUSED_1DEV_SERIAL_ON_CPU = False
 
 
 def _layout_spec(layout: str) -> P:
@@ -361,8 +369,41 @@ class LifeSim:
         mesh = self.mesh
         spec = _layout_spec(self.layout)
         interpret = jax.default_backend() != "tpu"
-        step_call = bitlife.make_plan_stepper(plan, interpret=interpret)
         dtype = self.dtype
+
+        if mesh.size == 1 and (not interpret
+                               or _BITFUSED_1DEV_SERIAL_ON_CPU):
+            # A 1-device mesh has no neighbours: the ghost-window
+            # redundancy ((nw_s+2h)/nw_s ≈ 1.5x extra cells at the 500²
+            # flagship) and the per-round exchange+launch cost buy
+            # nothing, so dispatch the board to the serial whole-board
+            # stepper — the sharded machinery begins at 2 devices. The
+            # plan's frame padding is sliced off/restored around the
+            # call (once per advance, amortised over the whole step
+            # budget); the serial dispatcher does its own padding.
+            # TPU-only: on CPU the interpret-mode tests keep exercising
+            # the exchange machinery this fast path would bypass.
+            from mpi_and_open_mp_tpu.ops.pallas_life import (
+                life_run_vmem, native_path)
+
+            ny, nx = self.cfg.shape
+            fy, fx = plan.frame
+            # on_tpu must mirror life_run_vmem's own dispatch decision
+            # or this provenance label could name a path that never runs.
+            self.plan_note = ("serial-1dev:"
+                              f"{native_path((ny, nx), on_tpu=not interpret)}")
+
+            @jax.jit
+            def advance(board, n):
+                out = life_run_vmem(board[:ny, :nx], jnp.int32(n))
+                out = jnp.pad(out, ((0, fy - ny), (0, fx - nx)))
+                return lax.with_sharding_constraint(
+                    out.astype(dtype), self.sharding)
+
+            return advance
+
+        self.plan_note = plan.mode
+        step_call = bitlife.make_plan_stepper(plan, interpret=interpret)
 
         def shard_fn(block, n):
             packed = bitlife.pack_board_exact(block)

@@ -438,8 +438,13 @@ _TPU_FLASH = os.environ.get("MOMP_TPU_FLASH", "1") != "0"
 
 def tpu_flash_engine() -> str:
     """Which engine ``flash_attention`` will dispatch eligible shapes to
-    — ``"pallas"`` or ``"jnp"`` — for recorders' provenance fields."""
-    return "pallas" if _TPU_FLASH else "jnp"
+    — ``"pallas"`` or ``"jnp"`` — for recorders' provenance fields.
+    Off-TPU the answer is always ``"jnp"`` regardless of the flag."""
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    return "pallas" if (_TPU_FLASH and on_tpu) else "jnp"
 
 
 def disable_tpu_flash() -> None:
@@ -451,6 +456,69 @@ def disable_tpu_flash() -> None:
     global _TPU_FLASH
     _TPU_FLASH = False
     jax.clear_caches()
+
+
+def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
+                       seed: int = 0) -> tuple[bool, str, list[str]]:
+    """THE honesty gate every attention recorder runs before recording:
+    check whatever engine :func:`flash_attention` dispatches to against
+    the dense oracle — FORWARD AND FULL (q, k, v) GRADIENTS, since the
+    recorders publish backward timings and the Pallas kernel brings its
+    own custom_vjp that only this gate ever checks on chip — at f32,
+    highest matmul precision (the default TPU f32 matmul takes bf16 MXU
+    passes whose rounding would swamp the algorithmic tolerance); on a
+    Pallas-engine failure (numeric or compile),
+    :func:`disable_tpu_flash` and re-gate the jnp engine.
+
+    Returns ``(ok, engine, notes)`` — ``engine`` is the engine the gate
+    passed on (= the one subsequent calls will use), ``notes`` records
+    any per-engine failure on the way. Callers decide abort-vs-continue
+    policy; the gate itself is shared so recorders cannot drift.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    q, k, v = (jnp.asarray(rng.standard_normal((heads, n, dim)),
+                           jnp.float32) for _ in range(3))
+
+    def close(a, b, tol):
+        return bool(np.allclose(np.asarray(a), np.asarray(b),
+                                rtol=tol, atol=tol))
+
+    def gate() -> bool:
+        with jax.default_matmul_precision("highest"):
+            got = flash_attention(q, k, v, causal=True)
+            want = attention_reference(q, k, v, causal=True)
+            if not close(got, want, 2e-4):
+                return False
+            g_got = jax.grad(
+                lambda a, b, c: jnp.sum(
+                    flash_attention(a, b, c, causal=True) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            g_want = jax.grad(
+                lambda a, b, c: jnp.sum(
+                    attention_reference(a, b, c, causal=True) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+        return all(close(a, b, 5e-4) for a, b in zip(g_got, g_want))
+
+    notes: list[str] = []
+
+    def attempt() -> bool:
+        try:
+            ok = gate()
+        except Exception as e:
+            notes.append(f"{tpu_flash_engine()} engine: "
+                         f"{type(e).__name__}: {e}"[:160])
+            return False
+        if not ok:
+            notes.append(f"{tpu_flash_engine()} engine failed parity")
+        return ok
+
+    ok = attempt()
+    if not ok and _TPU_FLASH:
+        disable_tpu_flash()
+        ok = attempt()
+    return ok, tpu_flash_engine(), notes
 
 
 def _pallas_flash_eligible(q, k, v) -> bool:

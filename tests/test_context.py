@@ -235,21 +235,46 @@ def test_single_device_ring_delegates_chunked(rng, small_chunks):
                                    rtol=1e-5, atol=1e-5)
 
 
-def test_ring_attention_chunked_grad_parity(rng, sp_mesh, small_chunks):
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_chunked_grad_parity(rng, sp_mesh, causal,
+                                            small_chunks):
     small_chunks(16)
     q, k, v = _qkv(rng, 2, 256, 8)
 
     def loss_sharded(q, k, v):
-        return jnp.sum(ring_attention(q, k, v, mesh=sp_mesh, causal=True) ** 2)
+        return jnp.sum(
+            ring_attention(q, k, v, mesh=sp_mesh, causal=causal) ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
 
     g_got = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
     g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for got, want in zip(g_got, g_want):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_bf16_grad(rng, sp_mesh):
+    """bf16 primals through the ring flash backward: bf16 grads out
+    (f32 accumulation inside), loose tolerance vs the f32 oracle."""
+    q, k, v = _qkv(rng, 2, 128, 16, dtype=jnp.bfloat16)
+
+    def loss(q_, k_, v_):
+        return jnp.sum(ring_attention(
+            q_, k_, v_, mesh=sp_mesh, causal=True).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        lambda a, b, c: jnp.sum(attention_reference(a, b, c, causal=True)
+                                ** 2),
+        argnums=(0, 1, 2))(q.astype(jnp.float32), k.astype(jnp.float32),
+                           v.astype(jnp.float32))
+    for got, want, nm in zip(g, gf, "qkv"):
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                                   np.asarray(want), rtol=0.1, atol=0.1,
+                                   err_msg=f"d{nm}")
 
 
 @pytest.mark.parametrize("causal", [False, True])

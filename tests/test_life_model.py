@@ -146,6 +146,34 @@ def test_parity_bitfused_unaligned(make_board, shape, layout, mesh_args, steps):
     np.testing.assert_array_equal(sim.collect(), oracle_n(board, steps))
 
 
+def test_bitfused_1dev_serial_dispatch(make_board, monkeypatch):
+    """A 1-device mesh has no neighbours: the bitfused path dispatches
+    to the serial whole-board stepper (no ghost-window redundancy, no
+    exchange rounds), sliced out of / re-padded into the plan's frame —
+    and must stay bit-exact, including across what would have been
+    fused-round boundaries. CPU-gated behind the test flag so the
+    interpret suite's machinery coverage is unchanged by default."""
+    from mpi_and_open_mp_tpu.models import life as life_mod
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+    board = make_board(100, 130)
+    cfg = config_from_board(board, steps=150, save_steps=0)
+    mesh = mesh_lib.make_mesh_1d(1, axis="y")
+
+    # Default on CPU: the exchange machinery runs even on 1 device.
+    sim_default = LifeSim(cfg, layout="row", impl="bitfused", mesh=mesh)
+    assert sim_default.plan_note == sim_default._plan.mode
+
+    monkeypatch.setattr(life_mod, "_BITFUSED_1DEV_SERIAL_ON_CPU", True)
+    sim = LifeSim(cfg, layout="row", impl="bitfused", mesh=mesh)
+    assert sim.plan_note.startswith("serial-1dev:")
+    sim.step(150)  # crosses the machinery's k_max round boundary
+    np.testing.assert_array_equal(sim.collect(), oracle_n(board, 150))
+    # The sharded-state contract survives: the stored board keeps the
+    # plan's frame shape, so snapshots/checkpoints are unaffected.
+    assert sim.board.shape == sim._plan.frame
+
+
 def test_bitfused_gates(make_board):
     with pytest.raises(ValueError, match="sharded layout"):
         LifeSim(config_from_board(make_board(2048, 128), 1, 1),
