@@ -514,8 +514,11 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
             notes.append(f"{tpu_flash_engine()} engine failed parity")
         return ok
 
+    # Retry keyed on the engine the first attempt actually dispatched to
+    # (not the bare flag): off-TPU a jnp failure would otherwise trigger
+    # a pointless cache drop and an identical second jnp run.
     ok = attempt()
-    if not ok and _TPU_FLASH:
+    if not ok and tpu_flash_engine() == "pallas":
         disable_tpu_flash()
         ok = attempt()
     return ok, tpu_flash_engine(), notes

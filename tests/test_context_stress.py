@@ -63,6 +63,10 @@ def test_sweep_covers_the_space():
     # exceeds the (shrunk) chunk: ulysses/local see the full n.
     assert any(c[8] and c[0] in ("ulysses", "local") and c[4] > _CHUNK
                for c in cases), "no flash-backward case sampled"
+    # The RING flash backward (_ring_flash_bwd: counter-rotating dk/dv
+    # accumulators) engages on any multi-device ring gradient case.
+    assert any(c[8] and c[0] == "ring" and c[1] > 1
+               for c in cases), "no ring-flash-backward case sampled"
 
 
 @pytest.fixture(autouse=True)
