@@ -477,6 +477,14 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     """
     import numpy as np
 
+    # The gate must exercise the same engine the timed shapes will get:
+    # under a MOMP_FLASH_BLOCK override, round the gate sequence up to a
+    # block multiple so the Pallas kernel (with those very block sizes)
+    # is what gets checked — otherwise an oversized block would make the
+    # gate silently jnp-only while the recordings dispatch ungated.
+    blk = _flash_block_override()
+    if blk:
+        n = -(-n // blk) * blk
     rng = np.random.default_rng(seed)
     q, k, v = (jnp.asarray(rng.standard_normal((heads, n, dim)),
                            jnp.float32) for _ in range(3))
@@ -524,12 +532,33 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     return ok, tpu_flash_engine(), notes
 
 
+def _flash_block_override() -> int:
+    """The validated ``MOMP_FLASH_BLOCK`` value (0 = kernel default).
+    One shared parse for the routing predicate, the dispatch, and the
+    parity gate, so they cannot disagree on the effective block — and a
+    typo'd knob fails loudly with its own name, not as an opaque error
+    from some later dispatch."""
+    raw = os.environ.get("MOMP_FLASH_BLOCK", "").strip()
+    if not raw:
+        return 0
+    try:
+        b = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"MOMP_FLASH_BLOCK={raw!r} is not an integer") from None
+    if b < 0 or (b and (b < 128 or b % 128)):
+        raise ValueError(
+            f"MOMP_FLASH_BLOCK={b} must be 0 or a multiple of 128 >= 128")
+    return b
+
+
 def _pallas_flash_eligible(q, k, v) -> bool:
     """Static (trace-time) routing predicate for the bundled Pallas TPU
     kernel: TPU backend, no GQA folding (the kernel wants equal head
     counts; our folded jnp path is the better GQA engine anyway),
-    128-multiple sequence (the kernel's default block), MXU-width head
-    dim, and a dtype the MXU takes directly."""
+    block-multiple sequence (128 = the kernel's default block, or the
+    ``MOMP_FLASH_BLOCK`` override), MXU-width head dim, and a dtype the
+    MXU takes directly."""
     if not _TPU_FLASH:
         return False
     try:
@@ -538,7 +567,8 @@ def _pallas_flash_eligible(q, k, v) -> bool:
     except RuntimeError:  # no backend at all (early init)
         return False
     h, n, d = q.shape
-    return (k.shape[0] == h and n % 128 == 0 and d % 128 == 0
+    blk = _flash_block_override() or 128
+    return (k.shape[0] == h and n % blk == 0 and d % 128 == 0
             and q.dtype in (jnp.float32, jnp.bfloat16)
             and k.dtype == q.dtype and v.dtype == q.dtype)
 
@@ -547,12 +577,23 @@ def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:
     """Dispatch one (heads, seq, d) attention to the bundled Pallas TPU
     flash kernel (batch dim added/stripped; same 1/sqrt(d) scaling as
     ``attention_reference``). Differentiable via the kernel's own
-    flash custom_vjp."""
+    flash custom_vjp. ``MOMP_FLASH_BLOCK=<n>`` overrides the kernel's
+    default (128) block edge uniformly — a measurement knob so a chip
+    session can sweep block sizes without code edits; the recorders'
+    parity gates cover whatever value is set."""
     from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
+    blocks = None
+    b = _flash_block_override()
+    if b:  # eligibility required seq % b == 0 for this same b
+        blocks = fa.BlockSizes(
+            block_q=b, block_k_major=b, block_k=b, block_b=1,
+            block_q_major_dkv=b, block_k_major_dkv=b,
+            block_k_dkv=b, block_q_dkv=b,
+            block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
     out = fa.flash_attention(
         q[None], k[None], v[None], causal=causal,
-        sm_scale=1.0 / math.sqrt(q.shape[-1]))
+        sm_scale=1.0 / math.sqrt(q.shape[-1]), block_sizes=blocks)
     return out[0].astype(q.dtype)
 
 

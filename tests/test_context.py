@@ -528,6 +528,17 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
         *qkv(dt=jnp.float16))  # dtype
     assert not context._pallas_flash_eligible(
         *qkv(kdt=jnp.float32))  # mixed dtypes
+    # Block-size override tightens the divisibility requirement.
+    monkeypatch.setenv("MOMP_FLASH_BLOCK", "512")
+    assert context._pallas_flash_eligible(*qkv(n=1024))
+    assert not context._pallas_flash_eligible(*qkv(n=1280))  # % 512
+    # Bad knob values fail loudly with the knob's name, once.
+    for bad in ("128k", "96", "-128"):
+        monkeypatch.setenv("MOMP_FLASH_BLOCK", bad)
+        with pytest.raises(ValueError, match="MOMP_FLASH_BLOCK"):
+            context._flash_block_override()
+    monkeypatch.delenv("MOMP_FLASH_BLOCK")
+
     monkeypatch.setattr(context, "_TPU_FLASH", False)
     assert not context._pallas_flash_eligible(*qkv())  # kill switch
 
