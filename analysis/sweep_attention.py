@@ -152,9 +152,11 @@ def main(argv=None) -> int:
         flops = 2 * HEADS * n * n * DIM
 
         def point():
-            # Engine recorded per row: a mid-sweep fallback must be
-            # visible in the artifact, not only on stderr.
-            engine = context.tpu_flash_engine()
+            # Engine recorded per row, SHAPE-aware (a block override
+            # that doesn't divide this seq routes it to jnp): a
+            # mid-sweep fallback or per-shape downgrade must be visible
+            # in the artifact, not only on stderr.
+            engine = context.flash_engine_for(*qkv)
             fwd, diff_f = marginal(fwd_chain, qkv)
             if n <= args.bwd_max:
                 # grad runs fwd + bwd; standard fwd+bwd accounting is

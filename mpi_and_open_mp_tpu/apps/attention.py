@@ -42,6 +42,10 @@ def main(argv=None) -> int:
                    default="bfloat16")
     p.add_argument("--no-check", action="store_true",
                    help="skip the oracle parity check (long sequences)")
+    p.add_argument("--engine", choices=("auto", "jnp"), default="auto",
+                   help="auto = on TPU, eligible shapes dispatch to the "
+                   "bundled Pallas flash kernel; jnp = force the "
+                   "chunked XLA engine (same as MOMP_TPU_FLASH=0)")
     p.add_argument("--seed", type=int, default=0)
     add_platform_args(p)
     args = p.parse_args(argv)
@@ -51,6 +55,9 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
+
+    if args.engine == "jnp":
+        context.disable_tpu_flash()
 
     if args.variant == "flash":
         if args.devices not in (None, 1):

@@ -447,6 +447,15 @@ def tpu_flash_engine() -> str:
     return "pallas" if (_TPU_FLASH and on_tpu) else "jnp"
 
 
+def flash_engine_for(q, k, v) -> str:
+    """Shape-aware engine provenance: the engine ``flash_attention``
+    will actually dispatch THESE operands to. Recorders must stamp
+    artifacts with this (not the flag-level :func:`tpu_flash_engine`):
+    a block override that doesn't divide a timed sequence routes that
+    shape to the jnp engine regardless of the flag."""
+    return "pallas" if _pallas_flash_eligible(q, k, v) else "jnp"
+
+
 def disable_tpu_flash() -> None:
     """Force the jnp engine from here on (recorders call this when the
     Pallas kernel fails a parity gate or fails to compile). Drops jit
