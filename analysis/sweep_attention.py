@@ -1,10 +1,11 @@
 """Long-context attention scaling sweep on the real chip.
 
-Times the flash-chunked causal attention kernel that carries the
-long-context layer's per-shard compute (`parallel.flash_attention` — the
-same engine `ring_attention` folds per hop and `ulysses_attention` runs
-per head group) across sequence lengths, forward and backward (the
-rematerialised training path), in bfloat16 at (8 heads, d=128).
+Times the causal flash attention that carries the long-context layer's
+per-shard compute (`parallel.flash_attention` — the same engine
+`ring_attention` folds per hop and `ulysses_attention` runs per head
+group; on TPU eligible shapes dispatch to the bundled Pallas kernel,
+else the jnp-chunked path with its flash custom_vjp backward) across
+sequence lengths, forward and backward, in bfloat16 at (8 heads, d=128).
 
 Marginal per-call seconds by the same RTT-cancelling discipline as
 `bench.py`: chain R calls in one dispatch — each call's output feeds the
