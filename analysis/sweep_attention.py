@@ -78,14 +78,29 @@ def main(argv=None) -> int:
     # the engine flash_attention dispatches to must match the dense
     # oracle before any of its timings are recorded, with automatic
     # fallback (and re-gate) to the jnp engine on a Pallas failure so a
-    # chip window is never lost to a kernel problem. Re-run on every
-    # mid-sweep engine flip too.
-    ok, engine, notes = context.gated_parity_check(HEADS, 2048, DIM)
-    for note in notes:
-        print(note, file=sys.stderr)
-    if not ok:
-        print("parity check failed; not recording", file=sys.stderr)
-        return 1
+    # chip window is never lost to a kernel problem. Gated once per
+    # DISTINCT engine+block configuration among the swept sequences
+    # (for_seq pins each one), and re-run on every mid-sweep engine
+    # flip too.
+    gate_reps: dict[int | str, int] = {}
+    for n in args.seqs:
+        if n <= context._Q_CHUNK:
+            # Dispatches the dense reference — the oracle itself;
+            # nothing to gate (and its block value would otherwise
+            # collide with a genuinely Pallas-bound sequence's).
+            continue
+        cfg = (context._flash_block_for(n, DIM)
+               if context.tpu_flash_engine() == "pallas" else "jnp")
+        gate_reps.setdefault(cfg, n)
+    engine = "dense"
+    for rep in gate_reps.values():
+        ok, engine, notes = context.gated_parity_check(
+            HEADS, 2048, DIM, for_seq=rep)
+        for note in notes:
+            print(note, file=sys.stderr)
+        if not ok:
+            print("parity check failed; not recording", file=sys.stderr)
+            return 1
     print(f"engine: {engine}", file=sys.stderr)
 
     @functools.partial(jax.jit, static_argnames=("r",))
@@ -136,16 +151,12 @@ def main(argv=None) -> int:
             return (t2 - t1) / (r2 - 1), True
         return t1, False
 
+    from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
+
     rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine"]
 
     def flush() -> None:
-        # Written after EVERY point: a mid-sweep crash must not discard
-        # already-gated rows bought with scarce chip time.
-        outdir = os.path.dirname(args.out)
-        if outdir:
-            os.makedirs(outdir, exist_ok=True)
-        with open(args.out, "w") as f:
-            f.write("\n".join(rows) + "\n")
+        write_csv_rows(args.out, rows)
 
     for n in args.seqs:
         qkv = tuple(jnp.asarray(rng.standard_normal((HEADS, n, DIM)),
@@ -182,7 +193,8 @@ def main(argv=None) -> int:
             if not context._TPU_FLASH:
                 raise
             force_jnp(f"{type(e).__name__} at seq {n}")
-            ok, _, notes = context.gated_parity_check(HEADS, 2048, DIM)
+            ok, _, notes = context.gated_parity_check(
+                HEADS, 2048, DIM, for_seq=n)
             for note in notes:
                 print(note, file=sys.stderr)
             if not ok:

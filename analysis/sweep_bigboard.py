@@ -91,6 +91,8 @@ def main(argv=None) -> int:
 
     from mpi_and_open_mp_tpu.ops.pallas_life import native_path
 
+    from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
+
     rows = ["n,steps,path,steady_us_per_step,steady_gcups,differenced"]
     for n in args.sizes:
         # Aim ~0.5 s of steady compute per base run (floor 100 steps so
@@ -102,13 +104,9 @@ def main(argv=None) -> int:
             f"{n},{steps},{native_path((n, n))},"
             f"{sec * 1e6:.3f},{gcups:.1f},{int(diff)}"
         )
+        write_csv_rows(args.out, rows)  # after every point (crash-proof)
         print(rows[-1], flush=True)
 
-    outdir = os.path.dirname(args.out)
-    if outdir:
-        os.makedirs(outdir, exist_ok=True)
-    with open(args.out, "w") as f:
-        f.write("\n".join(rows) + "\n")
     print(f"wrote {args.out}", file=sys.stderr)
     return 0
 

@@ -195,10 +195,13 @@ def main(argv=None) -> int:
         # sweep_attention runs): whichever engine flash_attention
         # dispatches to must match the dense oracle before its timings
         # are recorded, with automatic fallback to the jnp engine.
-        # Unlike the sweep, a total gate failure doesn't abort — the
-        # bench line (with the Life numbers already in hand) still
-        # prints, carrying the error instead of attention fields.
-        attn_ok, _, gate_notes = context.gated_parity_check()
+        # for_seq aims the gate at the exact engine+block configuration
+        # the timed 32k operands will dispatch. Unlike the sweep, a
+        # total gate failure doesn't abort — the bench line (with the
+        # Life numbers already in hand) still prints, carrying the
+        # error instead of attention fields.
+        attn_ok, _, gate_notes = context.gated_parity_check(
+            for_seq=32 * 1024)
         if gate_notes:
             # Recorded even when the gate ultimately passed: an engine
             # downgrade (pallas -> jnp) must be explained in the

@@ -429,11 +429,23 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 # On-TPU the single-device engine can dispatch to jax's bundled Pallas
 # flash-attention kernel (block-pipelined HBM->VMEM, MXU-shaped tiles)
 # instead of the jnp-chunked path, which tops out around 25% MFU as pure
-# XLA. The jnp path remains the CPU/interpret oracle and the fallback
-# for shapes the kernel doesn't take. MOMP_TPU_FLASH=0 forces the jnp
-# engine everywhere (and the sweep's parity gate flips this off at
-# runtime if the kernel ever disagrees with the dense oracle).
+# XLA. The kernel is only faster with EXPLICIT block sizes: chip
+# head-to-head (v5 lite, 8 heads, d=128, causal bf16, chain-differenced)
+# measured the kernel's own default blocks at 15-17 TFLOP/s forward —
+# SLOWER than the 47-49 jnp engine — while uniform 512/1024 blocks reach
+# 105-140 forward and 84-120 full-grad TFLOP/s (the jnp flash backward
+# runs ~32). 2048 blocks fail to compile (VMEM). Dispatch therefore
+# always passes explicit blocks (:func:`_flash_block_for`). The jnp
+# path remains the CPU/interpret oracle and the fallback for shapes the
+# kernel doesn't take. MOMP_TPU_FLASH=0 forces the jnp engine
+# everywhere (and the sweep's parity gate flips this off at runtime if
+# the kernel ever disagrees with the dense oracle).
 _TPU_FLASH = os.environ.get("MOMP_TPU_FLASH", "1") != "0"
+
+# Chip-validated uniform block edges, best first; the auto dispatch
+# picks the largest that divides the sequence (gate + recorders then
+# exercise that very configuration).
+_AUTO_BLOCKS = (1024, 512, 256, 128)
 
 
 def tpu_flash_engine() -> str:
@@ -449,11 +461,18 @@ def tpu_flash_engine() -> str:
 
 def flash_engine_for(q, k, v) -> str:
     """Shape-aware engine provenance: the engine ``flash_attention``
-    will actually dispatch THESE operands to. Recorders must stamp
-    artifacts with this (not the flag-level :func:`tpu_flash_engine`):
-    a block override that doesn't divide a timed sequence routes that
-    shape to the jnp engine regardless of the flag."""
-    return "pallas" if _pallas_flash_eligible(q, k, v) else "jnp"
+    will actually dispatch THESE operands to, with the effective block
+    edge (``"pallas:b512"``) since perf swings ~8x across blocks.
+    Recorders must stamp artifacts with this (not the flag-level
+    :func:`tpu_flash_engine`): a block override that doesn't divide a
+    timed sequence routes that shape to the jnp engine regardless of
+    the flag. Sequences at or below the chunk size short-circuit to the
+    dense reference before any engine dispatch and stamp ``"dense"``."""
+    if q.shape[1] <= _Q_CHUNK:  # mirrors _attention_chunked's ordering
+        return "dense"
+    if _pallas_flash_eligible(q, k, v):
+        return f"pallas:b{_flash_block_for(q.shape[1], q.shape[2])}"
+    return "jnp"
 
 
 def disable_tpu_flash() -> None:
@@ -468,7 +487,8 @@ def disable_tpu_flash() -> None:
 
 
 def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
-                       seed: int = 0) -> tuple[bool, str, list[str]]:
+                       seed: int = 0, for_seq: int | None = None,
+                       ) -> tuple[bool, str, list[str]]:
     """THE honesty gate every attention recorder runs before recording:
     check whatever engine :func:`flash_attention` dispatches to against
     the dense oracle — FORWARD AND FULL (q, k, v) GRADIENTS, since the
@@ -479,6 +499,15 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     Pallas-engine failure (numeric or compile),
     :func:`disable_tpu_flash` and re-gate the jnp engine.
 
+    ``for_seq`` aims the gate at the exact engine+block configuration a
+    length-``for_seq`` dispatch will use (the dense oracle is O(n²), so
+    the gate cannot simply run at the timed length): a Pallas-bound
+    sequence pins its effective block for the gate's smaller run, and a
+    jnp-bound one steers the gate sequence off the 128-multiple grid so
+    the gate dispatches the jnp engine too. Recorders timing several
+    sequences must gate once per distinct configuration
+    (``_flash_block_for(seq, dim)``).
+
     Returns ``(ok, engine, notes)`` — ``engine`` is the engine the gate
     passed on (= the one subsequent calls will use), ``notes`` records
     any per-engine failure on the way. Callers decide abort-vs-continue
@@ -486,13 +515,31 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     """
     import numpy as np
 
-    # The gate must exercise the same engine the timed shapes will get:
-    # under a MOMP_FLASH_BLOCK override, round the gate sequence up to a
-    # block multiple so the Pallas kernel (with those very block sizes)
-    # is what gets checked — otherwise an oversized block would make the
-    # gate silently jnp-only while the recordings dispatch ungated.
-    blk = _flash_block_override()
-    if blk:
+    global _FORCED_BLOCK
+    forced = 0
+    steer_jnp = False
+    if for_seq is not None and tpu_flash_engine() == "pallas":
+        blk = _flash_block_for(for_seq, dim)
+        if blk and for_seq % blk == 0 and for_seq > _Q_CHUNK:
+            forced = blk
+        else:
+            # The timed shape is jnp-bound (no block divides it, or an
+            # override doesn't): steer the gate sequence off the block
+            # grid so the gate dispatches the jnp engine too.
+            steer_jnp = True
+            if n % 128 == 0:
+                n += 16
+
+    # The gate must exercise the same engine+block the timed shapes will
+    # get: under a pin (MOMP_FLASH_BLOCK override, which wins, or the
+    # for_seq force above), round the gate sequence up to a block
+    # multiple so the Pallas kernel with those very block sizes is what
+    # gets checked — otherwise an oversized block would make the gate
+    # silently jnp-only while the recordings dispatch ungated. (Not
+    # when steering jnp-ward: the round-up would put an overridden
+    # block's multiple right back on the Pallas grid.)
+    blk = _flash_block_override() or forced
+    if blk and not steer_jnp:
         n = -(-n // blk) * blk
     rng = np.random.default_rng(seed)
     q, k, v = (jnp.asarray(rng.standard_normal((heads, n, dim)),
@@ -534,11 +581,17 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     # Retry keyed on the engine the first attempt actually dispatched to
     # (not the bare flag): off-TPU a jnp failure would otherwise trigger
     # a pointless cache drop and an identical second jnp run.
-    ok = attempt()
-    if not ok and tpu_flash_engine() == "pallas":
-        disable_tpu_flash()
+    _FORCED_BLOCK = forced
+    try:
         ok = attempt()
-    return ok, tpu_flash_engine(), notes
+        if not ok and tpu_flash_engine() == "pallas" and not steer_jnp:
+            disable_tpu_flash()
+            ok = attempt()
+    finally:
+        _FORCED_BLOCK = 0
+    # When the steer aimed the gate at the jnp engine, that IS the
+    # engine the for_seq shape will use — report it, not the flag.
+    return ok, ("jnp" if steer_jnp else tpu_flash_engine()), notes
 
 
 def _flash_block_override() -> int:
@@ -561,13 +614,48 @@ def _flash_block_override() -> int:
     return b
 
 
+# Gate-time pin of the auto block choice (module-internal; see
+# gated_parity_check): lets the small-sequence parity gate run the very
+# block configuration a larger timed sequence will dispatch, since the
+# dense oracle is O(n^2) and cannot be evaluated at the timed length.
+_FORCED_BLOCK = 0
+
+# b*d budget for the auto choice, anchored at the chip-validated
+# (b=1024, d=128) point: 2048*128 failed to compile (VMEM), so wider
+# head dims scale the block edge down rather than risk an unvalidated
+# footprint on library callers with no fallback path.
+_BLOCK_BUDGET = 1024 * 128
+
+
+def _block_pin() -> int:
+    """The pinned block edge, if any: the ``MOMP_FLASH_BLOCK`` env
+    override, else the gate's module-internal force."""
+    return _flash_block_override() or _FORCED_BLOCK
+
+
+def _flash_block_for(n: int, d: int = 128) -> int:
+    """Effective Pallas block edge for a ``(seq=n, head_dim=d)``
+    dispatch: the pin (env override / gate force) if set, else the
+    largest chip-validated block (``_AUTO_BLOCKS``) dividing ``n``
+    within the ``b*d <= _BLOCK_BUDGET`` footprint. 0 = no block fits
+    (the shape is then jnp-engine territory)."""
+    b = _block_pin()
+    if b:
+        return b
+    for b in _AUTO_BLOCKS:
+        if b * d <= _BLOCK_BUDGET and n % b == 0:
+            return b
+    return 0
+
+
 def _pallas_flash_eligible(q, k, v) -> bool:
     """Static (trace-time) routing predicate for the bundled Pallas TPU
     kernel: TPU backend, no GQA folding (the kernel wants equal head
-    counts; our folded jnp path is the better GQA engine anyway),
-    block-multiple sequence (128 = the kernel's default block, or the
-    ``MOMP_FLASH_BLOCK`` override), MXU-width head dim, and a dtype the
-    MXU takes directly."""
+    counts; our folded jnp path is the better GQA engine anyway), a
+    validated block edge that divides the sequence within the ``b*d``
+    footprint budget (:func:`_flash_block_for`; a pinned block tightens
+    divisibility to its own multiple), MXU-width head dim, and a dtype
+    the MXU takes directly."""
     if not _TPU_FLASH:
         return False
     try:
@@ -576,8 +664,8 @@ def _pallas_flash_eligible(q, k, v) -> bool:
     except RuntimeError:  # no backend at all (early init)
         return False
     h, n, d = q.shape
-    blk = _flash_block_override() or 128
-    return (k.shape[0] == h and n % blk == 0 and d % 128 == 0
+    blk = _flash_block_for(n, d)
+    return (k.shape[0] == h and d % 128 == 0 and blk != 0 and n % blk == 0
             and q.dtype in (jnp.float32, jnp.bfloat16)
             and k.dtype == q.dtype and v.dtype == q.dtype)
 
@@ -586,20 +674,22 @@ def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:
     """Dispatch one (heads, seq, d) attention to the bundled Pallas TPU
     flash kernel (batch dim added/stripped; same 1/sqrt(d) scaling as
     ``attention_reference``). Differentiable via the kernel's own
-    flash custom_vjp. ``MOMP_FLASH_BLOCK=<n>`` overrides the kernel's
-    default (128) block edge uniformly — a measurement knob so a chip
-    session can sweep block sizes without code edits; the recorders'
-    parity gates cover whatever value is set."""
+    flash custom_vjp. Blocks are ALWAYS explicit — the kernel's own
+    defaults measured 3x slower than the jnp engine on chip, explicit
+    512/1024 blocks 2-4x faster (see the ``_TPU_FLASH`` note) — sized
+    by :func:`_flash_block_for` (largest validated edge dividing seq;
+    ``MOMP_FLASH_BLOCK=<n>`` overrides uniformly, a measurement knob so
+    a chip session can sweep block sizes without code edits; the
+    recorders' parity gates cover whatever value is in effect)."""
     from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
-    blocks = None
-    b = _flash_block_override()
-    if b:  # eligibility required seq % b == 0 for this same b
-        blocks = fa.BlockSizes(
-            block_q=b, block_k_major=b, block_k=b, block_b=1,
-            block_q_major_dkv=b, block_k_major_dkv=b,
-            block_k_dkv=b, block_q_dkv=b,
-            block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
+    # eligibility ensured a block exists and seq % b == 0
+    b = _flash_block_for(q.shape[1], q.shape[2])
+    blocks = fa.BlockSizes(
+        block_q=b, block_k_major=b, block_k=b, block_b=1,
+        block_q_major_dkv=b, block_k_major_dkv=b,
+        block_k_dkv=b, block_q_dkv=b,
+        block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
     out = fa.flash_attention(
         q[None], k[None], v[None], causal=causal,
         sm_scale=1.0 / math.sqrt(q.shape[-1]), block_sizes=blocks)

@@ -64,3 +64,16 @@ def append_times_txt(path: str, seconds: float) -> None:
     accumulation used by the reference launchers (``3-life/run_life.sh:5``)."""
     with open(path, "a") as fd:
         fd.write(f"{seconds:.3f}\n")
+
+
+def write_csv_rows(path: str, rows: list[str]) -> None:
+    """(Re)write a CSV artifact whole, creating its directory. The chip
+    sweeps call this after EVERY recorded point so a mid-sweep crash
+    cannot discard rows bought with scarce chip time."""
+    import os
+
+    outdir = os.path.dirname(path)
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    with open(path, "w") as fd:
+        fd.write("\n".join(rows) + "\n")

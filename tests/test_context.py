@@ -528,10 +528,34 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
         *qkv(dt=jnp.float16))  # dtype
     assert not context._pallas_flash_eligible(
         *qkv(kdt=jnp.float32))  # mixed dtypes
+    # Auto block: largest chip-validated edge dividing the sequence
+    # within the b*d budget, stamped into the shape-aware provenance.
+    assert context._flash_block_for(32768) == 1024
+    assert context._flash_block_for(1536) == 512
+    assert context._flash_block_for(1280) == 256
+    assert context._flash_block_for(384) == 128
+    assert context._flash_block_for(32768, d=256) == 512  # budget scales
+    assert context._flash_block_for(32768, d=1024) == 128
+    assert context._flash_block_for(32768, d=2048) == 0  # no block fits
+    assert not context._pallas_flash_eligible(*qkv(d=2048))
+    assert context.flash_engine_for(*qkv(n=1024)) == "pallas:b1024"
+    assert context.flash_engine_for(*qkv(n=1000)) == "jnp"
+    # At or below the chunk size the dispatch short-circuits to the
+    # dense reference before any engine — provenance must say so.
+    assert context.flash_engine_for(*qkv(n=512)) == "dense"
+
+    # The gate's module-internal force pins the auto choice (so a small
+    # gate run exercises a larger timed sequence's configuration)...
+    monkeypatch.setattr(context, "_FORCED_BLOCK", 512)
+    assert context._flash_block_for(32768) == 512
+
     # Block-size override tightens the divisibility requirement.
     monkeypatch.setenv("MOMP_FLASH_BLOCK", "512")
     assert context._pallas_flash_eligible(*qkv(n=1024))
     assert not context._pallas_flash_eligible(*qkv(n=1280))  # % 512
+    monkeypatch.setattr(context, "_FORCED_BLOCK", 256)
+    assert context._flash_block_for(32768) == 512  # ...but env wins
+    monkeypatch.setattr(context, "_FORCED_BLOCK", 0)
     # Bad knob values fail loudly with the knob's name, once.
     for bad in ("128k", "96", "-128"):
         monkeypatch.setenv("MOMP_FLASH_BLOCK", bad)
