@@ -521,7 +521,20 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
 
     monkeypatch.setattr(context.jax, "default_backend", lambda: "tpu")
     assert context._pallas_flash_eligible(*qkv())
-    assert not context._pallas_flash_eligible(*qkv(hkv=2))  # GQA -> jnp
+    # GQA is never DIRECTLY eligible (the kernel wants equal heads)...
+    assert not context._pallas_flash_eligible(*qkv(hkv=2))
+    # ...but the dispatch plan expands budget-fitting K/V to reach the
+    # kernel (chip-measured ~2.7x over the folded jnp path), and the
+    # provenance stamp says so.
+    assert context._flash_dispatch_plan(*qkv(hkv=2)) == ("expand", 1024, 2)
+    assert context.flash_engine_for(*qkv(hkv=2)) == "pallas:b1024:kvx2"
+    # Over the expand budget (2 GiB combined K+V) GQA stays on the
+    # folded jnp engine. Shape probes only — nothing this size is
+    # allocated.
+    big = [jax.ShapeDtypeStruct((h, 1 << 20, 128), jnp.bfloat16)
+           for h in (8, 2, 2)]
+    assert context._flash_dispatch_plan(*big) is None
+    assert context.flash_engine_for(*big) == "jnp"
     assert not context._pallas_flash_eligible(*qkv(n=1000))  # seq % 128
     assert not context._pallas_flash_eligible(*qkv(d=64))  # head dim
     assert not context._pallas_flash_eligible(
@@ -565,6 +578,23 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
 
     monkeypatch.setattr(context, "_TPU_FLASH", False)
     assert not context._pallas_flash_eligible(*qkv())  # kill switch
+
+
+def test_gated_parity_check_cpu():
+    """The recorders' shared honesty gate on the CPU (jnp) engine:
+    passes clean for equal-head and GQA/MQA configurations — the GQA
+    form checks the gate's group-summed oracle gradients — and reports
+    the engine the timed shape will use."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    ok, engine, notes = context.gated_parity_check(n=640)
+    assert ok and engine == "jnp" and notes == []
+    ok, engine, notes = context.gated_parity_check(n=640, kv_heads=2)
+    assert ok and engine == "jnp" and notes == []
+    # MQA, with a for_seq (no-op off-TPU: flag-level engine is jnp).
+    ok, engine, _ = context.gated_parity_check(
+        n=640, kv_heads=1, for_seq=32768)
+    assert ok and engine == "jnp"
 
 
 def test_ring_attention_default_mesh(rng):
