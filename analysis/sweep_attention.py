@@ -18,8 +18,11 @@ half). Emits a CSV:
 where `bwd_sec` times one FULL grad step (forward + backward per chain
 link — a backward can't run without its forward), `bwd_tflops` uses
 the matching fwd+bwd = 3.5x fwd accounting, and `engine` records which
-attention engine (pallas kernel / jnp chunked) produced the row — a
-mid-sweep fallback is visible in the artifact.
+attention engine+block configuration (e.g. `pallas:b1024`, with a
+`:kvxG` suffix for the GQA expand dispatch, or `jnp`) produced the
+row — a mid-sweep fallback is visible in the artifact. `--kv-heads`
+sweeps a GQA/MQA configuration instead (TFLOP/s still counts the
+q-heads, which carry the compute).
 
 Usage: python analysis/sweep_attention.py [--out results/attention/attention_tpu.csv]
 """
@@ -50,7 +53,19 @@ def main(argv=None) -> int:
                     help="auto = let flash_attention dispatch to the "
                     "bundled Pallas TPU kernel on eligible shapes; jnp "
                     "= force the chunked XLA engine")
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA/MQA: fewer K/V heads (must divide the "
+                    f"fixed {HEADS} q-heads); rows time the GQA engine "
+                    "the dispatch picks (expand-to-Pallas within "
+                    "budget, folded jnp otherwise) and the gate checks "
+                    "that very configuration")
     args = ap.parse_args(argv)
+
+    hkv = HEADS if args.kv_heads is None else args.kv_heads
+    if hkv < 1 or HEADS % hkv:
+        print(f"--kv-heads {hkv} must be a positive divisor of {HEADS}",
+              file=sys.stderr)
+        return 2
 
     import jax
     import jax.numpy as jnp
@@ -82,20 +97,22 @@ def main(argv=None) -> int:
     # DISTINCT engine+block configuration among the swept sequences
     # (for_seq pins each one), and re-run on every mid-sweep engine
     # flip too.
-    gate_reps: dict[int | str, int] = {}
+    gate_reps: dict[str, int] = {}
     for n in args.seqs:
         if n <= context._Q_CHUNK:
             # Dispatches the dense reference — the oracle itself;
-            # nothing to gate (and its block value would otherwise
-            # collide with a genuinely Pallas-bound sequence's).
+            # nothing to gate.
             continue
-        cfg = (context._flash_block_for(n, DIM)
-               if context.tpu_flash_engine() == "pallas" else "jnp")
-        gate_reps.setdefault(cfg, n)
+        # Key by the EXACT provenance stamp the row will carry (engine,
+        # block edge, GQA form — bf16 shape probes, nothing allocated),
+        # so two sequences gate separately iff they dispatch differently.
+        sq = jax.ShapeDtypeStruct((HEADS, n, DIM), jnp.bfloat16)
+        skv = jax.ShapeDtypeStruct((hkv, n, DIM), jnp.bfloat16)
+        gate_reps.setdefault(context.flash_engine_for(sq, skv, skv), n)
     engine = "dense"
     for rep in gate_reps.values():
         ok, engine, notes = context.gated_parity_check(
-            HEADS, 2048, DIM, for_seq=rep)
+            HEADS, 2048, DIM, for_seq=rep, kv_heads=hkv)
         for note in notes:
             print(note, file=sys.stderr)
         if not ok:
@@ -159,9 +176,11 @@ def main(argv=None) -> int:
         write_csv_rows(args.out, rows)
 
     for n in args.seqs:
-        qkv = tuple(jnp.asarray(rng.standard_normal((HEADS, n, DIM)),
-                                jnp.bfloat16) for _ in range(3))
-        flops = 2 * HEADS * n * n * DIM
+        qkv = (jnp.asarray(rng.standard_normal((HEADS, n, DIM)),
+                           jnp.bfloat16),
+               *(jnp.asarray(rng.standard_normal((hkv, n, DIM)),
+                             jnp.bfloat16) for _ in range(2)))
+        flops = 2 * HEADS * n * n * DIM  # q-heads carry the compute
 
         def point():
             # Engine recorded per row, SHAPE-aware (a block override
@@ -194,7 +213,7 @@ def main(argv=None) -> int:
                 raise
             force_jnp(f"{type(e).__name__} at seq {n}")
             ok, _, notes = context.gated_parity_check(
-                HEADS, 2048, DIM, for_seq=n)
+                HEADS, 2048, DIM, for_seq=n, kv_heads=hkv)
             for note in notes:
                 print(note, file=sys.stderr)
             if not ok:

@@ -165,6 +165,13 @@ def test_sweep_scripts_refuse_off_tpu(tmp_path):
         rc = mod.main(["--out", str(tmp_path / "x.csv")])
         assert rc == 1
         assert not (tmp_path / "x.csv").exists()
+    # GQA flag validation fires before the backend refusal; 0 and
+    # negative "divisors" are rejected too (0 would silently record a
+    # full-MHA sweep under a GQA label).
+    for bad in ("3", "0", "-2"):
+        rc = sweep_attention.main(
+            ["--kv-heads", bad, "--out", str(tmp_path / "x.csv")])
+        assert rc == 2
 
 
 def test_native_path_matches_dispatcher_gates():
