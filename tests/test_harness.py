@@ -174,6 +174,34 @@ def test_sweep_scripts_refuse_off_tpu(tmp_path):
         assert rc == 2
 
 
+def test_bench_cpu_end_to_end(capsys, monkeypatch):
+    """The driver-contract bench runs end-to-end through its CPU
+    fallback and prints one valid JSON line with the promised schema
+    (the TPU-only sharded/attention extras rightly absent). The
+    device-discovery probe is stubbed to fail: the suite must never
+    claim (or hang on) the real chip, and the fallback line — bench's
+    behaviour on a wedged relay — is exactly what's under test."""
+    import json
+
+    def deny(cmd, **kwargs):
+        raise subprocess.CalledProcessError(1, cmd)
+
+    monkeypatch.setattr(subprocess, "run", deny)
+    sys.path.insert(0, REPO)
+    import bench
+
+    rc = bench.main(["--board", "64", "--steps", "64"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "life_steady_cups_p46gun_big"
+    assert rec["unit"] == "cell_updates_per_sec"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    assert rec["backend"] == "cpu"
+    assert "not a TPU measurement" in rec["backend_fallback"]
+    assert "error" not in rec and "sharded_steady_cups" not in rec
+
+
 def test_native_path_matches_dispatcher_gates():
     """native_path is the single source of truth the sweeps label rows
     with; pin its decisions at the regime boundaries."""
