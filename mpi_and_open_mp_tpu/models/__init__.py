@@ -1,1 +1,2 @@
 from mpi_and_open_mp_tpu.models.life import LifeSim  # noqa: F401
+from mpi_and_open_mp_tpu.models.integral import Integral  # noqa: F401

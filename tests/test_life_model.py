@@ -10,7 +10,6 @@ import os
 import numpy as np
 import pytest
 
-import jax
 
 from mpi_and_open_mp_tpu.models.life import LifeSim
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib

@@ -201,3 +201,20 @@ def test_vtk_golden_cross_compat_with_reference_artifact(tmp_path):
         if i == 1:  # creator comment line differs by design
             continue
         assert g == w, f"line {i}: {g!r} != {w!r}"
+
+
+def test_config_cells_wrap_like_reference_ind_macro(tmp_path):
+    """Out-of-range and negative cell coordinates wrap onto the torus —
+    the reference's loader writes cells through its `ind` macro
+    (`3-life/life2d.c:9,69`: `((i+nx)%nx) + ((j+ny)%ny)*nx`), so a cfg
+    listing (9,9) on a 4x4 board lights (1,1), and (-1,2) lights (3,2).
+    Python's % matches the macro for ANY magnitude, including beyond
+    -nx where the macro's single +nx would not — pinned here so a
+    future loader rewrite keeps the quirk."""
+    p = tmp_path / "wrap.cfg"
+    p.write_text("5\n1\n4 4\n9 9\n-1 2\n")
+    cfg = load_config_py(p)
+    b = cfg.board()
+    assert b.sum() == 2
+    assert b[1, 1] == 1  # (i=9, j=9) -> (1, 1)
+    assert b[2, 3] == 1  # (i=-1, j=2) -> col 3, row 2
