@@ -38,6 +38,12 @@ def main(argv=None) -> int:
                    help="GQA/MQA: fewer K/V heads than query heads")
     p.add_argument("--devices", type=int, default=None,
                    help="sp ring size (default: all local devices)")
+    p.add_argument("--ring-layout", choices=("contiguous", "zigzag"),
+                   default="contiguous",
+                   help="ring variant only: zigzag = striped causal-"
+                   "load-balanced token layout (the driver permutes "
+                   "operands in and outputs back out, so the parity "
+                   "check still runs in natural order)")
     p.add_argument("--dtype", choices=("float32", "bfloat16"),
                    default="bfloat16")
     p.add_argument("--no-check", action="store_true",
@@ -72,6 +78,14 @@ def main(argv=None) -> int:
         mesh = mesh_lib.make_mesh_1d(args.devices, axis=context.AXIS_SP)
         fn = (context.ring_attention if args.variant == "ring"
               else context.ulysses_attention)
+    zig = args.ring_layout != "contiguous"
+    if zig:
+        if args.variant != "ring":
+            p.error("--ring-layout applies to --variant ring only")
+        ring = fn
+
+        def fn(q, k, v, mesh=None, causal=False):
+            return ring(q, k, v, mesh=mesh, causal=causal, layout="zigzag")
     dtype = jnp.dtype(args.dtype)
     rng = np.random.default_rng(args.seed)
     hkv = args.kv_heads or args.heads
@@ -80,6 +94,14 @@ def main(argv=None) -> int:
     k, v = (jnp.asarray(
         rng.standard_normal((hkv, args.seq, args.head_dim)), dtype)
         for _ in range(2))
+    qn, kn, vn = q, k, v  # natural order, for the oracle check
+    if zig:
+        # Pre-shard ONCE, outside the timed bracket — the zigzag order
+        # is a deployment-time layout, not per-step work; timing the
+        # permutes (plus their host sync) would bias exactly the
+        # zigzag-vs-contiguous comparison this flag exists to make.
+        pdev = mesh.shape[context.AXIS_SP]
+        q, k, v = (context.zigzag_shard(x, pdev) for x in (q, k, v))
 
     from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
@@ -105,14 +127,18 @@ def main(argv=None) -> int:
     out = (fn(q, k, v, mesh=mesh, causal=args.causal) if args.grad
            else result)
 
+    if zig:
+        # The zigzag output comes back in zigzag order; compare (and
+        # report) in natural order, against the natural-order oracle.
+        out = context.zigzag_unshard(out, pdev)
     if not args.no_check:
         # The dense oracle wants one K/V head per query head — expand
         # GQA/MQA heads explicitly (the variants keep them un-expanded).
         groups = args.heads // hkv
         want = context.attention_reference(
-            q.astype(jnp.float32),
-            jnp.repeat(k.astype(jnp.float32), groups, axis=0),
-            jnp.repeat(v.astype(jnp.float32), groups, axis=0),
+            qn.astype(jnp.float32),
+            jnp.repeat(kn.astype(jnp.float32), groups, axis=0),
+            jnp.repeat(vn.astype(jnp.float32), groups, axis=0),
             causal=args.causal)
         # On TPU, XLA's default matmul precision feeds the MXU bf16 even
         # for f32 operands, so differently-ordered reductions legitimately

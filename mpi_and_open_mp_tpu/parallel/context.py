@@ -78,6 +78,75 @@ def attention_reference(
 _Q_CHUNK = 512
 
 
+def _ring_positions(layout: str, dev, p: int, nl: int, local_rows):
+    """Global token positions for local row indices of a ring shard.
+
+    ``contiguous``: shard ``dev`` owns tokens ``[dev*nl, (dev+1)*nl)`` —
+    the natural split, with causal hop skipping but causal load
+    IMBALANCE (ring position p-1 computes p blocks per trip, position 0
+    one — the straggler sets the pace).
+
+    ``zigzag``: tokens are pre-sharded in ``2p`` half-chunks of
+    ``nl/2``; shard ``dev`` owns half-chunks ``(dev, 2p-1-dev)`` — the
+    striped/zigzag causal-balancing layout: every shard holds an equal
+    share of early AND late tokens, so every hop carries the same
+    half-masked block of work on every device. Use
+    :func:`zigzag_shard` / :func:`zigzag_unshard` to move operands
+    between natural and zigzag order.
+    """
+    if layout == "zigzag":
+        if nl % 2:
+            raise ValueError(
+                f"zigzag layout needs an even local length, got {nl}")
+        half = nl // 2
+        lo = local_rows < half
+        chunk = jnp.where(lo, dev, 2 * p - 1 - dev)
+        return chunk * half + local_rows - jnp.where(lo, 0, half)
+    if layout != "contiguous":
+        raise ValueError(f"unknown ring layout {layout!r}")
+    return dev * nl + local_rows
+
+
+@functools.lru_cache(maxsize=64)
+def zigzag_order(n: int, p: int):
+    """Natural token position held at each zigzag slot. Pure host numpy
+    (cached): ``x_zig = x[..., zigzag_order(n, p), :]`` produces the
+    operand order ``ring_attention(layout="zigzag")`` expects over a
+    ``p``-ring — no device ops are dispatched building it."""
+    import numpy as np
+
+    if n % (2 * p):
+        raise ValueError(f"zigzag needs seq % (2*mesh) == 0, got {n}/{p}")
+    nl = n // p
+    half = nl // 2
+    slot = np.arange(n)
+    shard, r = slot // nl, slot % nl
+    lo = r < half
+    chunk = np.where(lo, shard, 2 * p - 1 - shard)
+    out = chunk * half + np.where(lo, r, r - half)
+    out.setflags(write=False)  # cached: a caller mutation must not poison it
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def _zigzag_inverse(n: int, p: int):
+    import numpy as np
+
+    out = np.argsort(zigzag_order(n, p))
+    out.setflags(write=False)
+    return out
+
+
+def zigzag_shard(x, p: int):
+    """Permute ``(heads, seq, d)`` from natural to zigzag ring order."""
+    return jnp.take(x, zigzag_order(x.shape[1], p), axis=1)
+
+
+def zigzag_unshard(x, p: int):
+    """Inverse of :func:`zigzag_shard` (zigzag order back to natural)."""
+    return jnp.take(x, _zigzag_inverse(x.shape[1], p), axis=1)
+
+
 def _mask_from_pos(qpos, kpos, n: int | None, causal: bool):
     """Boolean ``(nq, nk)`` allow-mask from position vectors: ``kpos < n``
     validity (padding) when ``n`` is given, causality when ``causal`` —
@@ -136,7 +205,8 @@ def _block_update(q32, k, v, qpos, kpos, n, causal, o, m, l):
     return o, m_new, l
 
 
-def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
+def _ring_attention_local(q, k, v, *, axis: str, causal: bool,
+                          layout: str = "contiguous"):
     """Per-shard body (inside ``shard_map``): rotate K/V around the ring.
 
     Each of the ``p`` hops computes one (n_local x n_local) score block and
@@ -151,20 +221,31 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     """
     p = lax.axis_size(axis)
     if p == 1:
-        # A 1-device ring is just full local attention; the doubly-chunked
-        # local path additionally skips future k blocks under causal.
-        # GQA folds query groups on the jnp engine; on TPU,
-        # budget-fitting GQA expands K/V into the Pallas kernel instead
-        # (_flash_dispatch_plan).
+        # A 1-device ring is just full local attention (under EITHER
+        # layout: the p=1 zigzag order is the identity); the
+        # doubly-chunked local path additionally skips future k blocks
+        # under causal. GQA folds query groups on the jnp engine; on
+        # TPU, budget-fitting GQA expands K/V into the Pallas kernel
+        # instead (_flash_dispatch_plan).
         return _attention_chunked(q, k, v, causal)
-    return _ring_flash(axis, causal, q, k, v)
+    return _ring_flash(axis, causal, layout, q, k, v)
 
 
-def _ring_forward(axis: str, causal: bool, q, k, v):
+def _ring_forward(axis: str, causal: bool, layout: str, q, k, v):
     """The rotate-and-fold forward; returns the normalised output and the
     per-row logsumexp ``L = m + log l`` of the scaled scores in the FOLDED
     GQA layout ``(hkv, n_local·g)`` — the one statistic the ring backward
-    needs to recompute any hop's probabilities as ``exp(s - L)``."""
+    needs to recompute any hop's probabilities as ``exp(s - L)``.
+
+    ``layout`` picks the token-to-shard map (:func:`_ring_positions`):
+    every position the masks see flows from it. Causal-zigzag hops run
+    HALF-blocks (live-pair table in the zigzag branch below): per hop
+    each device computes only its live (q-half x k-half) pairs — two
+    quarter-size blocks off the diagonal, three (two of them
+    half-masked) on the src == idx hop — so a causal trip costs every
+    device about half a full-block per hop, versus the contiguous split
+    where hop wall-clock is set by whichever device's block is
+    unskipped (the straggler)."""
     p = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     nl, d = q.shape[1:]
@@ -176,70 +257,118 @@ def _ring_forward(axis: str, causal: bool, q, k, v):
     # the local flash path — no repeated K/V is ever materialised.
     q32 = _fold_groups(q.astype(jnp.float32), hkv, g)
     perm = ring_perm(p, 1)
-
-    # Flash-style q chunking whenever the shard is long: q rows are
-    # independent, so pad them to a chunk multiple (padded rows compute
-    # junk that is sliced off at the end) — no divisibility cliff.
-    chunked = nl > _Q_CHUNK
-    nc = -(-nl // _Q_CHUNK)
-    nlp = nc * _Q_CHUNK if chunked else nl
     cg = _Q_CHUNK * g
-    if chunked and nlp != nl:
-        q32 = jnp.pad(q32, ((0, 0), (0, (nlp - nl) * g), (0, 0)))
-    o0 = jnp.zeros((hkv, nlp * g, d), jnp.float32)
-    m0 = jnp.full((hkv, nlp * g), _NEG, jnp.float32)
-    l0 = jnp.zeros((hkv, nlp * g), jnp.float32)
+    zz = causal and layout == "zigzag"
 
-    def fold(j, o, m, l, kb, vb):
-        # After j forward rotations my K/V block originated on ring
-        # position (idx - j) mod p.
-        src = (idx - j) % p
-        kpos = src * nl + jnp.arange(nl)
+    def make_folder(npos, qsub, qpos_of):
+        """(state0, fold, finish) for a q subset of ``npos`` positions
+        (folded rows ``npos*g``). Flash-style q chunking whenever the
+        subset is long: q rows are independent, so pad them to a chunk
+        multiple (padded rows compute junk that ``finish`` slices off)
+        — no divisibility cliff. ``qpos_of`` maps subset-local position
+        indices to global token positions."""
+        chunked = npos > _Q_CHUNK
+        nc = -(-npos // _Q_CHUNK)
+        npp = nc * _Q_CHUNK if chunked else npos
+        if npp != npos:
+            qsub = jnp.pad(qsub, ((0, 0), (0, (npp - npos) * g), (0, 0)))
+        rows = npp * g
+        state0 = (jnp.zeros((hkv, rows, d), jnp.float32),
+                  jnp.full((hkv, rows), _NEG, jnp.float32),
+                  jnp.zeros((hkv, rows), jnp.float32))
 
-        def compute(args):
-            kb, vb, o, m, l = args
+        def fold(state, kb, vb, kpos):
+            o, m, l = state
             if not chunked:
-                qpos = idx * nl + jnp.arange(nl * g) // g
-                return _block_update(q32, kb, vb, qpos, kpos, None, causal,
-                                     o, m, l)
+                qpos = qpos_of(jnp.arange(npos * g) // g)
+                return _block_update(qsub, kb, vb, qpos, kpos, None,
+                                     causal, o, m, l)
             # Scan q (and its running state) in (hkv, _Q_CHUNK * g)
-            # folded slices so only a (hkv, _Q_CHUNK * g, nl) score
+            # folded slices so only a (hkv, _Q_CHUNK * g, nk) score
             # block is ever live.
-
-            def to_chunks(x):
-                return _chunk(x, nc, cg)
 
             def body(_, xs):
                 qc, oc, mc, lc, ci = xs
-                qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(cg) // g
+                qpos = qpos_of(ci * _Q_CHUNK + jnp.arange(cg) // g)
                 oc, mc, lc = _block_update(qc, kb, vb, qpos, kpos, None,
                                            causal, oc, mc, lc)
                 return None, (oc, mc, lc)
 
             _, (os_, ms, ls) = lax.scan(
                 body, None,
-                (to_chunks(q32), to_chunks(o), to_chunks(m), to_chunks(l),
-                 jnp.arange(nc)),
-            )
+                (_chunk(qsub, nc, cg), _chunk(o, nc, cg),
+                 _chunk(m, nc, cg), _chunk(l, nc, cg), jnp.arange(nc)))
             return _unchunk(os_), _unchunk(ms), _unchunk(ls)
 
-        if not causal:
-            return compute((kb, vb, o, m, l))
-        # Blocks entirely in the future (src > idx) contribute nothing;
-        # skip their matmul+exp instead of computing and masking it out
-        # (~(p-1)/2 of the hops on average). The predicate differs per
-        # device (idx-dependent), so neither branch may contain a
-        # collective — the ppermutes stay outside, in the hop body. cond
-        # is reverse-mode differentiable; the scan lowering is unaffected.
-        return lax.cond(
-            src <= idx,
-            compute,
-            lambda args: (args[2], args[3], args[4]),
-            (kb, vb, o, m, l),
-        )
+        def finish(state):
+            return tuple(x[:, : npos * g] for x in state)
+
+        return state0, fold, finish
+
+    if not zz:
+        state0, fold_q, finish = make_folder(
+            nl, q32, lambda r: _ring_positions(layout, idx, p, nl, r))
+
+        def fold(j, state, kb, vb):
+            # After j forward rotations my K/V block originated on ring
+            # position (idx - j) mod p.
+            src = (idx - j) % p
+            kpos = _ring_positions(layout, src, p, nl, jnp.arange(nl))
+            if not causal:
+                return fold_q(state, kb, vb, kpos)
+            # Contiguous causal: blocks entirely in the future
+            # (src > idx) contribute nothing; skip their matmul+exp
+            # instead of computing and masking it out (~(p-1)/2 of the
+            # hops on average). The predicate differs per device
+            # (idx-dependent), so neither branch may contain a
+            # collective — the ppermutes stay outside, in the hop body.
+            # cond is reverse-mode differentiable; the scan lowering is
+            # unaffected.
+            return lax.cond(
+                src <= idx,
+                lambda s: fold_q(s, kb, vb, kpos),
+                lambda s: s,
+                state)
+    else:
+        # Causal zigzag: shard idx holds half-chunks (idx, 2p-1-idx) of
+        # size half = nl/2. Of the four (q-half x k-half) pairs per hop
+        # only these ever carry unmasked work (`_zz_pairs`):
+        #   (lo, lo)  iff src <= idx   (diagonal at src == idx)
+        #   (hi, lo)  always           (high chunks are after every low)
+        #   (hi, hi)  iff src >= idx   (diagonal at src == idx)
+        # — (lo, hi) is always fully masked. That is two quarter-blocks
+        # per off-diagonal hop (three on the diagonal hop, two of them
+        # half-masked) on EVERY device: balanced, and about half the
+        # FLOPs of a masked full block.
+        half = nl // 2
+        hg = half * g
+        s_lo0, fold_lo, fin_lo = make_folder(
+            half, q32[:, :hg], lambda r: idx * half + r)
+        s_hi0, fold_hi, fin_hi = make_folder(
+            half, q32[:, hg:], lambda r: (2 * p - 1 - idx) * half + r)
+
+        def fold(j, state, kb, vb):
+            s_lo, s_hi = state
+            src = (idx - j) % p
+            k_lo, k_hi = kb[:, :half], kb[:, half:]
+            v_lo, v_hi = vb[:, :half], vb[:, half:]
+            kpos_lo = src * half + jnp.arange(half)
+            kpos_hi = (2 * p - 1 - src) * half + jnp.arange(half)
+            s_lo = lax.cond(
+                src <= idx,
+                lambda s: fold_lo(s, k_lo, v_lo, kpos_lo),
+                lambda s: s, s_lo)
+            s_hi = fold_hi(s_hi, k_lo, v_lo, kpos_lo)
+            s_hi = lax.cond(
+                src >= idx,
+                lambda s: fold_hi(s, k_hi, v_hi, kpos_hi),
+                lambda s: s, s_hi)
+            return s_lo, s_hi
+
+        state0 = (s_lo0, s_hi0)
 
     def hop(j, carry):
-        o, m, l, kb, vb = carry
+        state, kb, vb = carry
         # Double-buffered rotation: issue the NEXT hop's K/V transfer
         # before folding the block just received, so the async
         # collective-permute rides the fabric while the MXU computes the
@@ -250,27 +379,30 @@ def _ring_forward(axis: str, causal: bool, q, k, v):
         # collectives inside a per-device branch would deadlock the ring.
         kb_next = lax.ppermute(kb, axis, perm)
         vb_next = lax.ppermute(vb, axis, perm)
-        o, m, l = fold(j, o, m, l, kb, vb)
-        return o, m, l, kb_next, vb_next
+        state = fold(j, state, kb, vb)
+        return state, kb_next, vb_next
 
     # p-1 rotate+compute hops, then a final fold with no trailing rotation
     # (the p-th ppermute pair would only feed discarded loop carries).
-    o, m, l, kb, vb = lax.fori_loop(0, p - 1, hop, (o0, m0, l0, k, v))
-    o, m, l = fold(p - 1, o, m, l, kb, vb)
-    if nlp != nl:
-        o, m, l = o[:, : nl * g], m[:, : nl * g], l[:, : nl * g]
+    state, kb, vb = lax.fori_loop(0, p - 1, hop, (state0, k, v))
+    state = fold(p - 1, state, kb, vb)
+    if zz:
+        o, m, l = (jnp.concatenate(parts, axis=1) for parts in zip(
+            fin_lo(state[0]), fin_hi(state[1])))
+    else:
+        o, m, l = finish(state)
     L = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-37)), -_NEG)
     o = o / jnp.where(l > 0, l, 1.0)[..., None]
     return _unfold_groups(o, hkv, g).astype(q.dtype), L
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _ring_flash(axis: str, causal: bool, q, k, v):
-    return _ring_forward(axis, causal, q, k, v)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_flash(axis: str, causal: bool, layout: str, q, k, v):
+    return _ring_forward(axis, causal, layout, q, k, v)[0]
 
 
-def _ring_flash_fwd(axis: str, causal: bool, q, k, v):
-    o, L = _ring_forward(axis, causal, q, k, v)
+def _ring_flash_fwd(axis: str, causal: bool, layout: str, q, k, v):
+    o, L = _ring_forward(axis, causal, layout, q, k, v)
     return o, (q, k, v, o, L)
 
 
@@ -304,7 +436,7 @@ def _flash_block_grads(qc, doc, Lc, Dc, kb, vb, mask, scale: float):
     )
 
 
-def _ring_flash_bwd(axis: str, causal: bool, res, do):
+def _ring_flash_bwd(axis: str, causal: bool, layout: str, res, do):
     """Ring flash backward: O(seq·d/p) residuals on the sharded path.
 
     K/V blocks make a second trip around the ring, each carrying its own
@@ -344,60 +476,115 @@ def _ring_flash_bwd(axis: str, causal: bool, res, do):
     D = jnp.sum(do32 * o32, axis=-1)  # (hkv, nl*g)
     Lf = L
 
-    # Same q-chunking decision as the forward; padded rows carry
-    # L = -_NEG (huge) so their recomputed p underflows to 0 — they
-    # contribute nothing to dk/dv and their dq rows are sliced off.
-    chunked = nl > _Q_CHUNK
-    nc = -(-nl // _Q_CHUNK)
-    nlp = nc * _Q_CHUNK if chunked else nl
     cg = _Q_CHUNK * g
-    if chunked and nlp != nl:
-        rows = (nlp - nl) * g
-        q32 = jnp.pad(q32, ((0, 0), (0, rows), (0, 0)))
-        do32 = jnp.pad(do32, ((0, 0), (0, rows), (0, 0)))
-        D = jnp.pad(D, ((0, 0), (0, rows)))
-        Lf = jnp.pad(Lf, ((0, 0), (0, rows)), constant_values=-_NEG)
+    zz = causal and layout == "zigzag"
 
     def block_grads(qc, doc, Lc, Dc, qpos, kpos, kb32, vb32):
         mask = _mask_from_pos(qpos, kpos, None, causal)
         return _flash_block_grads(qc, doc, Lc, Dc, kb32, vb32, mask, scale)
 
-    def contribution(args):
-        j, kb, vb = args
-        src = (idx - j) % p
-        kpos = src * nl + jnp.arange(nl)
-        kb32, vb32 = kb.astype(f32), vb.astype(f32)
-        if not chunked:
-            qpos = idx * nl + jnp.arange(nl * g) // g
-            return block_grads(q32, do32, Lf, D, qpos, kpos, kb32, vb32)
+    def make_bwd(npos, qsub, dosub, Lsub, Dsub, qpos_of):
+        """Per-hop (dq, dk, dv) contribution fn for a q subset of
+        ``npos`` positions against one K/V block — the same q-chunking
+        decision as the forward's folder; padded rows carry L = -_NEG
+        (huge) so their recomputed p underflows to 0 — they contribute
+        nothing to dk/dv and their dq rows are sliced off."""
+        chunked = npos > _Q_CHUNK
+        nc = -(-npos // _Q_CHUNK)
+        npp = nc * _Q_CHUNK if chunked else npos
+        if npp != npos:
+            rows = (npp - npos) * g
+            qsub = jnp.pad(qsub, ((0, 0), (0, rows), (0, 0)))
+            dosub = jnp.pad(dosub, ((0, 0), (0, rows), (0, 0)))
+            Dsub = jnp.pad(Dsub, ((0, 0), (0, rows)))
+            Lsub = jnp.pad(Lsub, ((0, 0), (0, rows)),
+                           constant_values=-_NEG)
 
-        def body(carry, xs):
-            dka, dva = carry
-            qc, doc, Lc, Dc, ci = xs
-            qpos = idx * nl + ci * _Q_CHUNK + jnp.arange(cg) // g
-            dqc, dkc, dvc = block_grads(qc, doc, Lc, Dc, qpos, kpos,
-                                        kb32, vb32)
-            return (dka + dkc, dva + dvc), dqc
+        def contribution(kb32, vb32, kpos):
+            if not chunked:
+                qpos = qpos_of(jnp.arange(npos * g) // g)
+                dqs, dkj, dvj = block_grads(qsub, dosub, Lsub, Dsub,
+                                            qpos, kpos, kb32, vb32)
+                return dqs, dkj, dvj
 
-        z = jnp.zeros((hkv, nl, d), f32)
-        (dkj, dvj), dqs = lax.scan(
-            body, (z, z),
-            (_chunk(q32, nc, cg), _chunk(do32, nc, cg),
-             _chunk(Lf, nc, cg), _chunk(D, nc, cg), jnp.arange(nc)))
-        return _unchunk(dqs), dkj, dvj
+            def body(carry, xs):
+                dka, dva = carry
+                qc, doc, Lc, Dc, ci = xs
+                qpos = qpos_of(ci * _Q_CHUNK + jnp.arange(cg) // g)
+                dqc, dkc, dvc = block_grads(qc, doc, Lc, Dc, qpos, kpos,
+                                            kb32, vb32)
+                return (dka + dkc, dva + dvc), dqc
 
-    nrows = q32.shape[1]
+            z = jnp.zeros((hkv, kb32.shape[1], d), f32)
+            (dkj, dvj), dqs = lax.scan(
+                body, (z, z),
+                (_chunk(qsub, nc, cg), _chunk(dosub, nc, cg),
+                 _chunk(Lsub, nc, cg), _chunk(Dsub, nc, cg),
+                 jnp.arange(nc)))
+            return _unchunk(dqs)[:, : npos * g], dkj, dvj
 
-    def skipped(args):
-        return (jnp.zeros((hkv, nrows, d), f32),
-                jnp.zeros((hkv, nl, d), f32),
-                jnp.zeros((hkv, nl, d), f32))
+        return contribution
 
-    def contribute(j, kb, vb):
-        if not causal:
-            return contribution((j, kb, vb))
-        return lax.cond((idx - j) % p <= idx, contribution, skipped,
-                        (j, kb, vb))
+    if not zz:
+        contrib_q = make_bwd(
+            nl, q32, do32, Lf, D,
+            lambda r: _ring_positions(layout, idx, p, nl, r))
+
+        def contribute(j, kb, vb):
+            src = (idx - j) % p
+            kpos = _ring_positions(layout, src, p, nl, jnp.arange(nl))
+            if not causal:
+                return contrib_q(kb.astype(f32), vb.astype(f32), kpos)
+            # Hop skipping mirrors the forward (contiguous causal). The
+            # f32 casts live INSIDE the taken branch: as cond operands
+            # XLA would materialise them on skipped hops too.
+            return lax.cond(
+                src <= idx,
+                lambda _: contrib_q(kb.astype(f32), vb.astype(f32), kpos),
+                lambda _: (jnp.zeros((hkv, nl * g, d), f32),
+                           jnp.zeros((hkv, nl, d), f32),
+                           jnp.zeros((hkv, nl, d), f32)),
+                None)
+    else:
+        # Same live-pair analysis as the forward's causal-zigzag fold:
+        # (lo,lo) iff src <= idx; (hi,lo) always; (hi,hi) iff
+        # src >= idx; (lo,hi) never — two quarter-blocks of gradient
+        # work per off-diagonal hop (three on the diagonal hop),
+        # uniformly across devices.
+        half = nl // 2
+        hg = half * g
+        bwd_lo = make_bwd(half, q32[:, :hg], do32[:, :hg], Lf[:, :hg],
+                          D[:, :hg], lambda r: idx * half + r)
+        bwd_hi = make_bwd(half, q32[:, hg:], do32[:, hg:], Lf[:, hg:],
+                          D[:, hg:], lambda r: (2 * p - 1 - idx) * half + r)
+
+        def contribute(j, kb, vb):
+            src = (idx - j) % p
+            k_lo, k_hi = kb[:, :half], kb[:, half:]
+            v_lo, v_hi = vb[:, :half], vb[:, half:]
+            kpos_lo = src * half + jnp.arange(half)
+            kpos_hi = (2 * p - 1 - src) * half + jnp.arange(half)
+
+            def zero3(_):
+                return (jnp.zeros((hkv, hg, d), f32),
+                        jnp.zeros((hkv, half, d), f32),
+                        jnp.zeros((hkv, half, d), f32))
+
+            # f32 casts inside each taken branch (see the contiguous
+            # note); the always-live (hi, lo) pair casts unconditionally.
+            dq_lo, dk_lo, dv_lo = lax.cond(
+                src <= idx,
+                lambda _: bwd_lo(k_lo.astype(f32), v_lo.astype(f32),
+                                 kpos_lo), zero3, None)
+            dq_hi, dk_lo2, dv_lo2 = bwd_hi(k_lo.astype(f32),
+                                           v_lo.astype(f32), kpos_lo)
+            dq_hi2, dk_hi, dv_hi = lax.cond(
+                src >= idx,
+                lambda _: bwd_hi(k_hi.astype(f32), v_hi.astype(f32),
+                                 kpos_hi), zero3, None)
+            return (jnp.concatenate([dq_lo, dq_hi + dq_hi2], axis=1),
+                    jnp.concatenate([dk_lo + dk_lo2, dk_hi], axis=1),
+                    jnp.concatenate([dv_lo + dv_lo2, dv_hi], axis=1))
 
     def hop(j, carry):
         dq, kb, vb, dkb, dvb = carry
@@ -413,7 +600,7 @@ def _ring_flash_bwd(axis: str, causal: bool, res, do):
 
     z = jnp.zeros((hkv, nl, d), f32)
     dq, kb, vb, dkb, dvb = lax.fori_loop(
-        0, p - 1, hop, (jnp.zeros((hkv, nrows, d), f32), k, v, z, z))
+        0, p - 1, hop, (jnp.zeros((hkv, nl * g, d), f32), k, v, z, z))
     # Last block: contribute, then one final accumulator rotation (the
     # p-th) lands every (dk, dv) back on its home shard; kb/vb need no
     # trailing transfer.
@@ -421,7 +608,7 @@ def _ring_flash_bwd(axis: str, causal: bool, res, do):
     dq = dq + dqj
     dk = lax.ppermute(dkb + dkj, axis, perm)
     dv = lax.ppermute(dvb + dvj, axis, perm)
-    dq = _unfold_groups(dq[:, : nl * g], hkv, g).astype(q.dtype)
+    dq = _unfold_groups(dq, hkv, g).astype(q.dtype)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -1046,14 +1233,17 @@ def _repeat_heads(k, v, groups: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("local_fn", "mesh", "axis", "causal")
+    jax.jit,
+    static_argnames=("local_fn", "mesh", "axis", "causal", "layout"),
 )
 def _sharded_attention_jit(q, k, v, *, local_fn, mesh: Mesh, axis: str,
-                           causal: bool):
+                           causal: bool, **local_kwargs):
     """Shared jit + ``shard_map`` scaffold for both attention variants;
     ``local_fn`` is the module-level per-shard body (hashable, so the jit
-    cache keys stably on it)."""
-    body = functools.partial(local_fn, axis=axis, causal=causal)
+    cache keys stably on it); extra static kwargs (e.g. the ring
+    ``layout``) pass through."""
+    body = functools.partial(local_fn, axis=axis, causal=causal,
+                             **local_kwargs)
     spec = _seq_spec(axis)
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -1068,6 +1258,7 @@ def ring_attention(
     mesh: Mesh | None = None,
     axis: str = AXIS_SP,
     causal: bool = False,
+    layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Sequence-parallel attention over a ring mesh axis.
 
@@ -1075,15 +1266,37 @@ def ring_attention(
     ``axis``. K/V may carry fewer heads (GQA/MQA) as long as they divide
     the query heads. Peak memory per device is O(chunk * seq/p) scores —
     long contexts scale with the ring size. Returns the same sharding.
+
+    ``layout="zigzag"`` (striped ring attention) balances CAUSAL work:
+    under the contiguous split every hop's wall-clock is set by
+    whichever device's block is unskipped (there always is one), so a
+    causal trip costs ~p full-block times despite computing only half
+    the scores. Zigzag pre-shards tokens in ``2p`` half-chunks, shard
+    ``i`` holding half-chunks ``(i, 2p-1-i)``; each hop then computes
+    only its LIVE (q-half x k-half) quarter-blocks (two off the
+    diagonal hop, three on it) — uniformly on every device, forward
+    and backward — roughly halving the causal trip's critical path. Operands must arrive in zigzag order
+    (:func:`zigzag_shard`; invert outputs/gradients with
+    :func:`zigzag_unshard`); needs ``seq % (2 * mesh size) == 0``.
     """
     if mesh is None:
         mesh = mesh_lib.make_mesh_1d(axis=axis)
-    _check_seq(q.shape[1], mesh.shape[axis], "ring_attention")
+    p = mesh.shape[axis]
+    _check_seq(q.shape[1], p, "ring_attention")
     _check_gqa(q, k, v, "ring_attention")
+    if layout not in ("contiguous", "zigzag"):
+        # Eagerly: the p == 1 local path never consults the layout, and
+        # a typo must not run silently there.
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if layout == "zigzag" and q.shape[1] % (2 * p):
+        raise ValueError(
+            f"ring_attention zigzag layout needs seq % (2*mesh) == 0, "
+            f"got seq {q.shape[1]} over {p} devices")
     sharding = NamedSharding(mesh, _seq_spec(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return _sharded_attention_jit(q, k, v, local_fn=_ring_attention_local,
-                                  mesh=mesh, axis=axis, causal=causal)
+                                  mesh=mesh, axis=axis, causal=causal,
+                                  layout=layout)
 
 
 def flash_attention(

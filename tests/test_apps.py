@@ -62,9 +62,10 @@ def test_integral_cli_truncate_32bit(capsys):
 def test_attention_cli(capsys):
     from mpi_and_open_mp_tpu.apps import attention
 
-    for variant in ("ring", "ulysses"):
-        rc = attention.main([
-            "--variant", variant, "--seq", "256", "--heads", "8",
+    for extra in (["--variant", "ring"], ["--variant", "ulysses"],
+                  ["--variant", "ring", "--ring-layout", "zigzag"]):
+        rc = attention.main(extra + [
+            "--seq", "256", "--heads", "8",
             "--head-dim", "16", "--causal", "--dtype", "float32",
         ])
         assert rc == 0

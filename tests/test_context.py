@@ -43,6 +43,118 @@ def test_ring_attention_parity(rng, sp_mesh, causal, h, n, d):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h,hkv,n,d", [(4, 4, 128, 32), (4, 2, 256, 16),
+                                       (2, 1, 144, 8)])
+def test_ring_attention_zigzag_parity(rng, sp_mesh, causal, h, hkv, n, d):
+    """The striped/zigzag causal-balanced layout is bit-for-bit the same
+    attention: operands permuted by zigzag_shard, outputs un-permuted by
+    zigzag_unshard, must match the dense oracle on natural order — the
+    positions the masks see are the layout's only degree of freedom."""
+    from mpi_and_open_mp_tpu.parallel.context import (
+        zigzag_shard, zigzag_unshard)
+
+    p = sp_mesh.shape["sp"]
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k, v = (jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+            for _ in range(2))
+    qz, kz, vz = (zigzag_shard(x, p) for x in (q, k, v))
+    got = zigzag_unshard(
+        ring_attention(qz, kz, vz, mesh=sp_mesh, causal=causal,
+                       layout="zigzag"), p)
+    want = attention_reference(
+        q, jnp.repeat(k, h // hkv, axis=0),
+        jnp.repeat(v, h // hkv, axis=0), causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [256, 272])  # 272: nl=34, half=17 -> padded
+def test_ring_attention_zigzag_chunked(rng, sp_mesh, small_chunks, n):
+    """Causal zigzag through the CHUNKED half-folders (fwd + grads): a
+    tiny _Q_CHUNK forces the per-half q scans, 272 additionally makes
+    the halves non-chunk-multiples so the padding rules fire."""
+    from mpi_and_open_mp_tpu.parallel.context import (
+        zigzag_shard, zigzag_unshard)
+
+    small_chunks(8)
+    p = sp_mesh.shape["sp"]
+    h, hkv, d = 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k, v = (jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+            for _ in range(2))
+    qz, kz, vz = (zigzag_shard(x, p) for x in (q, k, v))
+
+    got = zigzag_unshard(
+        ring_attention(qz, kz, vz, mesh=sp_mesh, causal=True,
+                       layout="zigzag"), p)
+    want = attention_reference(
+        q, jnp.repeat(k, h // hkv, axis=0),
+        jnp.repeat(v, h // hkv, axis=0), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_zig(a, b, c):
+        return jnp.sum(ring_attention(a, b, c, mesh=sp_mesh, causal=True,
+                                      layout="zigzag") ** 2)
+
+    def loss_nat(a, b, c):
+        return jnp.sum(attention_reference(
+            a, jnp.repeat(b, h // hkv, axis=0),
+            jnp.repeat(c, h // hkv, axis=0), causal=True) ** 2)
+
+    g_zig = jax.grad(loss_zig, argnums=(0, 1, 2))(qz, kz, vz)
+    g_nat = jax.grad(loss_nat, argnums=(0, 1, 2))(q, k, v)
+    for gz, gn in zip(g_zig, g_nat):
+        np.testing.assert_allclose(np.asarray(zigzag_unshard(gz, p)),
+                                   np.asarray(gn), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_zigzag_grads(rng, sp_mesh):
+    """Zigzag gradients through the ring flash backward match the dense
+    oracle's, related by the zigzag permutation (dx_zig = dx_nat[perm])."""
+    from mpi_and_open_mp_tpu.parallel.context import (
+        zigzag_shard, zigzag_unshard)
+
+    p = sp_mesh.shape["sp"]
+    h, n, d = 2, 128, 16
+    q, k, v = _qkv(rng, h, n, d)
+    qz, kz, vz = (zigzag_shard(x, p) for x in (q, k, v))
+
+    def loss_zig(a, b, c):
+        return jnp.sum(ring_attention(a, b, c, mesh=sp_mesh, causal=True,
+                                      layout="zigzag") ** 2)
+
+    def loss_nat(a, b, c):
+        return jnp.sum(attention_reference(a, b, c, causal=True) ** 2)
+
+    g_zig = jax.grad(loss_zig, argnums=(0, 1, 2))(qz, kz, vz)
+    g_nat = jax.grad(loss_nat, argnums=(0, 1, 2))(q, k, v)
+    for gz, gn in zip(g_zig, g_nat):
+        np.testing.assert_allclose(np.asarray(zigzag_unshard(gz, p)),
+                                   np.asarray(gn), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_zigzag_validation(rng, sp_mesh):
+    from mpi_and_open_mp_tpu.parallel.context import (
+        zigzag_order, zigzag_shard, zigzag_unshard)
+
+    # seq 136 splits over 8 devices (17 each) but not into 16 half-chunks.
+    q, k, v = _qkv(rng, 2, 136, 8)
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_attention(q, k, v, mesh=sp_mesh, layout="zigzag")
+    with pytest.raises(ValueError, match="unknown ring layout"):
+        ring_attention(*_qkv(rng, 2, 128, 8), mesh=sp_mesh, layout="typo")
+    # The permutation pair is an exact inverse.
+    x = jnp.arange(3 * 64 * 4, dtype=jnp.float32).reshape(3, 64, 4)
+    np.testing.assert_array_equal(
+        np.asarray(zigzag_unshard(zigzag_shard(x, 8), 8)), np.asarray(x))
+    # Shard 0 of 4 owns half-chunks (0, 7): natural slots 0..7 and 56..63.
+    order = np.asarray(zigzag_order(64, 4))
+    np.testing.assert_array_equal(order[:16],
+                                  list(range(8)) + list(range(56, 64)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_parity(rng, sp_mesh, causal):
     q, k, v = _qkv(rng, 8, 128, 32)
     got = ulysses_attention(q, k, v, mesh=sp_mesh, causal=causal)
