@@ -88,6 +88,20 @@ for gg, gw, nm in zip(g_got, g_want, "qkv"):
     check_local(gg, np.asarray(gw),
                 f"multi-process ring flash backward d{nm}")
 
+# Striped/zigzag causal-balanced layout across the same real fabric:
+# the half-block hops and per-half dk/dv assembly must survive a true
+# process-boundary ppermute too.
+from mpi_and_open_mp_tpu.parallel.context import (  # noqa: E402
+    zigzag_order, zigzag_shard)
+
+sp = sp_mesh.shape["sp"]
+qkv_z = tuple(zigzag_shard(x, sp) for x in qkv)
+got_z = ring_attention(*qkv_z, mesh=sp_mesh, causal=True, layout="zigzag")
+# got_z is in zigzag order; compare each addressable shard against the
+# correspondingly-permuted oracle rows (slot -> natural position).
+want_z = want_a[:, np.asarray(zigzag_order(n, sp))]
+check_local(got_z, want_z, "multi-process zigzag ring attention")
+
 # Snapshot write: collective collect, process-0-only file write.
 import tempfile  # noqa: E402
 
