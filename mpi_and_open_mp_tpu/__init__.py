@@ -16,9 +16,10 @@ coursework repo ``kekoveca/MPI-and-Open-MP``:
   elapsed-seconds stdout, VTK snapshots, ``times.txt`` accumulation.
 * Beyond the reference: a first-class long-context sequence-parallel
   attention layer (ring + Ulysses + single-device ``flash_attention``,
-  un-expanded GQA/MQA, flash ``custom_vjp`` backwards on both the local
-  and the multi-device ring paths, TPU dispatch to the bundled Pallas
-  flash kernel for eligible shapes — ``parallel.context``), bit-packed
+  GQA/MQA, flash ``custom_vjp`` backwards on both the local and the
+  multi-device ring paths, a striped/zigzag causal-load-balanced ring
+  layout, TPU dispatch to the bundled Pallas flash kernel with
+  chip-validated explicit blocks — ``parallel.context``), bit-packed
   temporal-blocking Life kernels (one collective round per 128 steps —
   ``ops.bitlife``), Orbax checkpoint/resume, and a multi-host
   ``jax.distributed`` runtime.

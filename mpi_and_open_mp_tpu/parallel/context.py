@@ -16,6 +16,10 @@ Life halo exchange (``parallel.halo.ring_perm`` + ``lax.ppermute`` inside
   double-buffered: each hop issues the next rotation BEFORE folding the
   block in hand, so the transfer overlaps the MXU block matmuls; compute
   per hop is a dense (n_local x n_local) block that maps onto the MXU.
+  An optional striped/zigzag token layout (``layout="zigzag"`` +
+  ``zigzag_shard``/``zigzag_unshard``) balances CAUSAL work: half-block
+  hops, uniform across devices, roughly halving the causal trip's
+  critical path.
 * ``ulysses_attention`` — the all-to-all alternative: ``lax.all_to_all``
   re-shards from sequence-parallel to head-parallel, runs full local
   attention per head group, and all-to-alls back. Two collectives total
