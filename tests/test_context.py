@@ -148,6 +148,10 @@ def test_ring_attention_zigzag_validation(rng, sp_mesh):
     x = jnp.arange(3 * 64 * 4, dtype=jnp.float32).reshape(3, 64, 4)
     np.testing.assert_array_equal(
         np.asarray(zigzag_unshard(zigzag_shard(x, 8), 8)), np.asarray(x))
+    # The cached permutations are frozen: a caller mutating the returned
+    # array must fail loudly, not silently poison every later shard.
+    with pytest.raises(ValueError):
+        zigzag_order(64, 8)[0] = 1
     # Shard 0 of 4 owns half-chunks (0, 7): natural slots 0..7 and 56..63.
     order = np.asarray(zigzag_order(64, 4))
     np.testing.assert_array_equal(order[:16],
