@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Multi-process long-context attention job — the scheduler-shaped
+# launcher for the layer with no reference analog (the ring/Ulysses
+# drivers), same topology-via-environment contract as the other job_*
+# launchers (the role PBS's $PBS_NODEFILE + mpirun played for the
+# reference, /root/reference/3-life/job_life.sh:2-8). Each rank holds
+# one CPU device; the sp ring's ppermutes cross real process
+# boundaries (the DCN-pod stand-in that tests/test_distributed.py
+# proves).
+#
+# Usage:
+#   launchers/job_attention.sh [--procs=N] [--variant=ring|ulysses]
+#                              [--seq=N] [--heads=N] [--head-dim=N]
+#                              [--kv-heads=N] [--layout=contiguous|zigzag]
+#                              [--causal] [--grad] [--times-file=FILE]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source launchers/_job_common.sh
+
+PROCS=2
+VARIANT=ring
+SEQ=512
+HEADS=4
+HEADDIM=16
+KVHEADS=""
+LAYOUT=contiguous
+CAUSAL=""
+GRAD=""
+TIMES=""
+for arg in "$@"; do
+  case "$arg" in
+    --procs=*)      PROCS="${arg#*=}" ;;
+    --variant=*)    VARIANT="${arg#*=}" ;;
+    --seq=*)        SEQ="${arg#*=}" ;;
+    --heads=*)      HEADS="${arg#*=}" ;;
+    --head-dim=*)   HEADDIM="${arg#*=}" ;;
+    --kv-heads=*)   KVHEADS="${arg#*=}" ;;
+    --layout=*)     LAYOUT="${arg#*=}" ;;
+    --causal)       CAUSAL=1 ;;
+    --grad)         GRAD=1 ;;
+    --times-file=*) TIMES="${arg#*=}" ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+extra=()
+[[ -n "$KVHEADS" ]] && extra+=(--kv-heads "$KVHEADS")
+[[ "$LAYOUT" != contiguous ]] && extra+=(--ring-layout "$LAYOUT")
+[[ -n "$CAUSAL" ]] && extra+=(--causal)
+[[ -n "$GRAD" ]] && extra+=(--grad)
+
+out=$(run_ranks "$PROCS" python -m mpi_and_open_mp_tpu.apps.attention \
+  --distributed --variant "$VARIANT" --seq "$SEQ" --heads "$HEADS" \
+  --head-dim "$HEADDIM" --dtype float32 ${extra[@]+"${extra[@]}"})
+echo "$out"
+if [[ -n "$TIMES" ]]; then
+  # The elapsed-seconds contract line (printed by the primary rank
+  # only) — matched by shape, since collective-backend banners (Gloo)
+  # share stdout and can interleave ahead of it.
+  echo "$out" | grep -Em1 '^[0-9]+\.[0-9]+$' >> "$TIMES"
+fi

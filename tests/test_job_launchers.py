@@ -60,3 +60,20 @@ def test_job_integral_two_process(tmp_path):
     lines = times.read_text().strip().splitlines()
     assert len(lines) == 2
     assert all(float(x) >= 0 for x in lines)
+
+
+def test_job_attention_zigzag_grad(tmp_path):
+    """The long-context job launcher: 2 real processes running the
+    striped/zigzag causal ring with GQA and the flash backward; the
+    primary rank's parity check passes and exactly one elapsed-seconds
+    line lands in the times file (Gloo banners share stdout, so the
+    launcher matches the contract line by shape)."""
+    times = tmp_path / "times_att.txt"
+    r = _run("job_attention.sh", "--procs=2", "--variant=ring",
+             "--layout=zigzag", "--seq=256", "--heads=4", "--kv-heads=2",
+             "--head-dim=16", "--causal", "--grad",
+             f"--times-file={times}")
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "parity ok" in r.stderr
+    lines = times.read_text().strip().splitlines()
+    assert len(lines) == 1 and float(lines[0]) > 0
