@@ -22,6 +22,8 @@ from mpi_and_open_mp_tpu.parallel.context import (
     flash_attention,
     ring_attention,
     ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
 )
 
 N_CASES = 16
@@ -67,6 +69,14 @@ def test_sweep_covers_the_space():
     # accumulators) engages on any multi-device ring gradient case.
     assert any(c[8] and c[0] == "ring" and c[1] > 1
                for c in cases), "no ring-flash-backward case sampled"
+    # Ring cases additionally re-run under the striped/zigzag layout
+    # whenever it's legal (seq % 2p == 0); the half-block causal hops —
+    # forward AND backward — need a legal causal (grad) case to sample.
+    def zz_legal(c):
+        return c[0] == "ring" and c[1] > 1 and c[4] % (2 * c[1]) == 0
+    assert any(zz_legal(c) and c[6] for c in cases), "no causal zigzag run"
+    assert any(zz_legal(c) and c[6] and c[8]
+               for c in cases), "no causal zigzag gradient run"
 
 
 @pytest.fixture(autouse=True)
@@ -125,3 +135,26 @@ def test_random_attention_parity(case, rng):
             np.testing.assert_allclose(
                 np.asarray(gg, dtype=np.float32), np.asarray(gw),
                 rtol=1e-3, atol=1e-3, err_msg=f"{tag} d{name}")
+
+    # Every ring case ALSO runs the striped/zigzag layout when legal
+    # (coverage superset — the contiguous sweep above is untouched):
+    # permute in, un-permute out, so outputs and autodiff gradients
+    # compare directly against the same natural-order oracle.
+    if variant == "ring" and p > 1 and n % (2 * p) == 0:
+        def fn_zz(q_, k_, v_):
+            o = ring_attention(
+                zigzag_shard(q_, p), zigzag_shard(k_, p),
+                zigzag_shard(v_, p), mesh=mesh, causal=causal,
+                layout="zigzag")
+            return zigzag_unshard(o, p)
+
+        got_zz = np.asarray(fn_zz(q, k, v), dtype=np.float32)
+        np.testing.assert_allclose(got_zz, np.asarray(want), rtol=tol,
+                                   atol=tol, err_msg=f"{tag} zigzag")
+        if grad:
+            g_zz = jax.grad(lambda *a: loss(fn_zz, *a),
+                            argnums=(0, 1, 2))(q, k, v)
+            for gg, gw, name in zip(g_zz, g_want, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(gg, dtype=np.float32), np.asarray(gw),
+                    rtol=1e-3, atol=1e-3, err_msg=f"{tag} zigzag d{name}")
