@@ -28,6 +28,41 @@ NY = NX = 500
 STEPS = 10_000
 
 
+def _probe_devices(timeout_s: float) -> tuple[bool, str]:
+    """Can a subprocess finish jax device discovery in time?
+
+    On timeout the child is ABANDONED, never killed: a killed
+    mid-claim client is what wedges the relay for hours (see
+    .claude/skills/verify/SKILL.md) — and a kill here would land right
+    before the measurement the probe exists to protect. The orphan
+    either completes harmlessly (device freed on exit) or fails out on
+    the relay's own clock.
+    """
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile() as err:
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=err,
+        )
+        def tail() -> str:
+            err.seek(0)
+            text = err.read().decode(errors="replace").strip()
+            return f": ...{text[-160:]}" if text else ""
+
+        try:
+            rc = child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            # Snapshot whatever stderr the child produced so far — the
+            # relay error in it is what an operator needs to diagnose.
+            return False, ("TimeoutExpired: discovery hung; probe "
+                           "abandoned un-killed" + tail())
+        if rc == 0:
+            return True, ""
+        return False, f"probe exit {rc}" + tail()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--board", type=int, default=None, metavar="N",
@@ -43,30 +78,23 @@ def main(argv=None) -> int:
 
     # Backend watchdog: a wedged axon relay (observed after a TPU client
     # was killed mid-claim) makes jax.devices() hang indefinitely IN THIS
-    # PROCESS too — probe device discovery in a killable subprocess first
-    # and fall back to CPU (honestly labelled) so the bench records a
-    # line instead of hanging the harness.
+    # PROCESS too — probe device discovery in a subprocess first and fall
+    # back to CPU (honestly labelled) so the bench records a line instead
+    # of hanging the harness.
     import os
-    import subprocess
     backend_note = {}
     try:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
     except ValueError:
         probe_timeout = 240.0
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout, check=True, capture_output=True,
-        )
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+    ok, why = _probe_devices(probe_timeout)
+    if not ok:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        stderr = (e.stderr or b"").decode(errors="replace").strip()
         backend_note = {"backend_fallback": (
-            f"device discovery failed/hung ({type(e).__name__}"
-            + (f": ...{stderr[-160:]}" if stderr else "")
-            + "); ran on CPU — not a TPU measurement"
+            f"device discovery failed/hung ({why}); "
+            "ran on CPU — not a TPU measurement"
         )}
     import jax
 

@@ -183,13 +183,12 @@ def test_bench_cpu_end_to_end(capsys, monkeypatch):
     behaviour on a wedged relay — is exactly what's under test."""
     import json
 
-    def deny(cmd, **kwargs):
-        raise subprocess.CalledProcessError(1, cmd)
-
-    monkeypatch.setattr(subprocess, "run", deny)
     sys.path.insert(0, REPO)
     import bench
 
+    monkeypatch.setattr(
+        bench, "_probe_devices",
+        lambda timeout_s: (False, "stubbed: probe denied"))
     rc = bench.main(["--board", "64", "--steps", "64"])
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
