@@ -32,6 +32,6 @@ Subpackages
 ``utils``     config loading, VTK IO, timing, native-library bindings
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from mpi_and_open_mp_tpu.utils.config import LifeConfig, load_config  # noqa: F401
