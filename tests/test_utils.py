@@ -218,3 +218,15 @@ def test_config_cells_wrap_like_reference_ind_macro(tmp_path):
     assert b.sum() == 2
     assert b[1, 1] == 1  # (i=9, j=9) -> (1, 1)
     assert b[2, 3] == 1  # (i=-1, j=2) -> col 3, row 2
+
+
+def test_write_csv_rows(tmp_path):
+    """The sweeps' crash-proof per-point writer: creates the directory,
+    rewrites whole, trailing newline (artifact hygiene)."""
+    from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
+
+    out = tmp_path / "deep" / "rows.csv"
+    write_csv_rows(str(out), ["a,b", "1,2"])
+    assert out.read_text() == "a,b\n1,2\n"
+    write_csv_rows(str(out), ["a,b", "1,2", "3,4"])  # grows idempotently
+    assert out.read_text() == "a,b\n1,2\n3,4\n"
