@@ -213,8 +213,9 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool,
                           layout: str = "contiguous"):
     """Per-shard body (inside ``shard_map``): rotate K/V around the ring.
 
-    Each of the ``p`` hops computes one (n_local x n_local) score block and
-    folds it into the online softmax; K/V then move one hop forward — the
+    Each of the ``p`` hops computes one (n_local x n_local) score block
+    (its live quarter-blocks under the causal-zigzag layout) and folds it
+    into the online softmax; K/V then move one hop forward — the
     attention analogue of the ghost-row ``ppermute`` at
     ``parallel/halo.py:halo_pad_y`` (reference: ``3-life/life_mpi.c:203-207``).
 
