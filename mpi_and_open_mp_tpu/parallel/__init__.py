@@ -18,6 +18,7 @@ from mpi_and_open_mp_tpu.parallel.context import (  # noqa: F401
     flash_attention,
     ring_attention,
     ulysses_attention,
+    zigzag_order,
     zigzag_shard,
     zigzag_unshard,
     AXIS_SP,
