@@ -637,9 +637,24 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 _TPU_FLASH = os.environ.get("MOMP_TPU_FLASH", "1") != "0"
 
 # Chip-validated uniform block edges, best first; the auto dispatch
-# picks the largest that divides the sequence (gate + recorders then
-# exercise that very configuration).
+# picks the largest that divides the sequence AND leaves at least
+# _MIN_GRID programs per grid axis (gate + recorders then exercise that
+# very configuration).
 _AUTO_BLOCKS = (1024, 512, 256, 128)
+
+# Grid-occupancy floor for the auto block choice. Chip-measured at 8k
+# causal bf16 (8 heads, d=128): b=1024 leaves an 8x8 grid and the
+# kernel's vjp collapses to 25.8 TFLOP/s grad (79.5 fwd); b=512 (16x16)
+# measures 113.4 grad / 97.9 fwd — the backward needs >= ~16 programs
+# per axis to fill the chip's pipeline. 16k+ at b=1024 already satisfy
+# the floor (137-147 fwd measured), so only shorter sequences change.
+_MIN_GRID = 16
+
+# The floor only ever chooses between chip-measured edges (512/1024).
+# Sequences too short to form a _MIN_GRID-deep grid of >= this edge
+# (n < 8192) keep the plain largest-dividing choice rather than
+# extrapolate the 8k finding down to unmeasured 128/256 grids.
+_FLOOR_MIN_EDGE = 512
 
 
 def tpu_flash_engine() -> str:
@@ -853,15 +868,21 @@ def _flash_block_for(n: int, d: int = 128) -> int:
     """Effective Pallas block edge for a ``(seq=n, head_dim=d)``
     dispatch: the pin (env override / gate force) if set, else the
     largest chip-validated block (``_AUTO_BLOCKS``) dividing ``n``
-    within the ``b*d <= _BLOCK_BUDGET`` footprint. 0 = no block fits
-    (the shape is then jnp-engine territory)."""
+    within the ``b*d <= _BLOCK_BUDGET`` footprint that keeps the grid
+    at least ``_MIN_GRID`` programs per axis (short sequences starve
+    the kernel's backward below that — see the ``_MIN_GRID`` note),
+    considering only edges >= ``_FLOOR_MIN_EDGE`` for the floor; if
+    none qualifies, the largest fitting block regardless. 0 = no block
+    fits (the shape is then jnp-engine territory)."""
     b = _block_pin()
     if b:
         return b
-    for b in _AUTO_BLOCKS:
-        if b * d <= _BLOCK_BUDGET and n % b == 0:
+    fits = [b for b in _AUTO_BLOCKS
+            if b * d <= _BLOCK_BUDGET and n % b == 0]
+    for b in fits:
+        if b >= _FLOOR_MIN_EDGE and n >= _MIN_GRID * b:
             return b
-    return 0
+    return fits[0] if fits else 0
 
 
 def _pallas_flash_eligible(q, k, v) -> bool:
@@ -922,7 +943,9 @@ def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:
     flash custom_vjp. Blocks are ALWAYS explicit — the kernel's own
     defaults measured 3x slower than the jnp engine on chip, explicit
     512/1024 blocks 2-4x faster (see the ``_TPU_FLASH`` note) — sized
-    by :func:`_flash_block_for` (largest validated edge dividing seq;
+    by :func:`_flash_block_for` (largest validated edge dividing seq
+    that keeps >= ``_MIN_GRID`` grid programs per axis — 8k takes b512,
+    16k+ take b1024;
     ``MOMP_FLASH_BLOCK=<n>`` overrides uniformly, a measurement knob so
     a chip session can sweep block sizes without code edits; the
     recorders' parity gates cover whatever value is in effect)."""

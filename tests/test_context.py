@@ -658,8 +658,16 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
     assert not context._pallas_flash_eligible(
         *qkv(kdt=jnp.float32))  # mixed dtypes
     # Auto block: largest chip-validated edge dividing the sequence
-    # within the b*d budget, stamped into the shape-aware provenance.
+    # within the b*d budget AND leaving >= _MIN_GRID programs per grid
+    # axis (8k at b1024 measured an 8x8-grid backward collapse — see the
+    # _MIN_GRID note), stamped into the shape-aware provenance.
     assert context._flash_block_for(32768) == 1024
+    assert context._flash_block_for(16384) == 1024  # grid floor exactly met
+    assert context._flash_block_for(8192) == 512  # b1024 would leave 8x8
+    # Below 8k no >= _FLOOR_MIN_EDGE block can form a _MIN_GRID grid:
+    # fall back to the plain largest-dividing choice rather than
+    # extrapolate the 8k finding to unmeasured 128/256 grids.
+    assert context._flash_block_for(4096) == 1024
     assert context._flash_block_for(1536) == 512
     assert context._flash_block_for(1280) == 256
     assert context._flash_block_for(384) == 128
