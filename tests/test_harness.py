@@ -260,12 +260,14 @@ def test_committed_results_layer_parses():
     import csv as csv_mod
 
     for rel, col in (("life/bigboard_tpu.csv", "steady_gcups"),
-                     ("attention/attention_tpu.csv", "fwd_tflops")):
+                     ("attention/attention_tpu.csv", "fwd_tflops"),
+                     ("attention/attention_gqa_tpu.csv", "fwd_tflops")):
         with open(os.path.join(results, rel)) as f:
             rows = list(csv_mod.DictReader(f))
         assert rows and all(float(r[col]) > 0 for r in rows), rel
     for png in ("life/life_accel_virtual8.png", "network/network_params.png",
-                "life/bigboard_tpu.png", "attention/attention_tpu.png"):
+                "life/bigboard_tpu.png", "attention/attention_tpu.png",
+                "attention/attention_gqa_tpu.png"):
         assert os.path.getsize(os.path.join(results, png)) > 1000, png
 
 
