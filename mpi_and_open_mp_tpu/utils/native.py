@@ -4,7 +4,9 @@ The reference's runtime layer (config parsing + VTK serialisation,
 ``/root/reference/3-life/life2d.c:52-102``) is compiled C; this framework
 keeps that layer native too: ``native/lifeio.cpp`` built as ``liblifeio.so``.
 Python falls back transparently when the library hasn't been built
-(``make -C native``).
+(``make -C native``). Under a NON-editable install the repo-relative
+default can't resolve — set ``MOMP_NATIVE_LIB=/path/to/liblifeio.so``
+(the fast path is optional either way).
 """
 
 from __future__ import annotations
@@ -17,8 +19,13 @@ import numpy as np
 _LIB = None
 _TRIED = False
 
+# Default resolution assumes the module lives in the repo tree (in-place
+# use or an editable install); a NON-editable install has no native/
+# sibling, so MOMP_NATIVE_LIB points at the built .so explicitly there.
 _HERE = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SO_PATH = os.path.join(_HERE, "native", "liblifeio.so")
+_FROM_ENV = bool(os.environ.get("MOMP_NATIVE_LIB"))
+_SO_PATH = (os.environ.get("MOMP_NATIVE_LIB")
+            or os.path.join(_HERE, "native", "liblifeio.so"))
 
 
 def _load():
@@ -31,10 +38,22 @@ def _load():
     try:
         lib = ctypes.CDLL(_SO_PATH)
         lib.lifeio_life_steps_bits  # newest symbol: reject stale builds
-    except (OSError, AttributeError):
+    except (OSError, AttributeError) as e:
         # Missing OR out-of-date library (an old .so lacking newer
         # symbols would otherwise AttributeError past this guard) —
         # fall back to the Python implementations; `make -C native`.
+        # Quietly for the repo-relative default, but an EXPLICIT
+        # MOMP_NATIVE_LIB that fails to load is a misconfiguration the
+        # knob exists to fix — surface it instead of silently degrading.
+        # (_FROM_ENV, not a live env read: _SO_PATH was frozen at import,
+        # so the warning must describe the same snapshot it loaded from.)
+        if _FROM_ENV:
+            import warnings
+
+            warnings.warn(
+                f"MOMP_NATIVE_LIB={_SO_PATH} failed to load"
+                f" ({type(e).__name__}: {e}); falling back to the Python"
+                " implementations", RuntimeWarning, stacklevel=3)
         return None
     lib.lifeio_load_config.restype = ctypes.c_int
     lib.lifeio_load_config.argtypes = [
@@ -72,8 +91,10 @@ def _require():
     lib = _load()
     if lib is None:
         raise RuntimeError(
-            f"native lifeio library not built; run `make -C native` "
-            f"(expected at {_SO_PATH})"
+            f"native lifeio library not available (expected at {_SO_PATH}):"
+            " build it with `make -C native` in the repo tree, or point"
+            " MOMP_NATIVE_LIB at a built liblifeio.so (required for"
+            " non-editable installs, which carry no native/ sibling)"
         )
     return lib
 
