@@ -95,6 +95,9 @@ def main(argv=None) -> int:
         backend_note = {"backend_fallback": (
             f"device discovery failed/hung ({why}); "
             "ran on CPU — not a TPU measurement"
+        ), "chip_record": (
+            "results/bench_tpu_r05.jsonl holds committed real-chip "
+            "bench lines for this round"
         )}
     import jax
 
