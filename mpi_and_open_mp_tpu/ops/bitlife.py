@@ -748,11 +748,17 @@ def life_run_frame_bits(
     (wrap-patched rolls), stepped by the plan's window or tiled fused
     kernel — the single-device form of the sharded bitfused path, for
     shapes the aligned fused kernel rejects (``ny % 32``/``nx % 128``).
-    Measured v5e @ 10000² (post carry-save shave): 37.0 µs/step vs the
-    XLA packed loop's 32.6 — parity when XLA fully fuses its roll chain
-    into one HBM pass/step; the frame path's one-pass-per-128-steps
-    traffic bound is the robust property when it doesn't. Gate callers
-    on ``plan_sharded_bits(shape, 1, 1, False, False)``.
+    Measured v5e @ 10000² (r05 bigboard re-record,
+    ``results/life/bigboard_tpu.csv``): 66.5 µs/step = 1.50 Tcups
+    steady — the any-shape path at scale, with a
+    one-HBM-pass-per-128-steps traffic bound the XLA roll loop loses
+    once its intermediates spill through HBM (653 vs 242 µs/step at
+    16384², ``bit_step_xla`` docstring). An r04 probe recorded "37.0 vs
+    32.6 µs/step" for frame-vs-XLA at this size; 32.6 µs/step at 10⁸
+    cells would be 3.1 Tcups — above the 2.24 peak of the whole curve —
+    so that pair is considered a measurement error (superseded here; a
+    differenced A/B re-probe is queued). Gate callers on
+    ``plan_sharded_bits(shape, 1, 1, False, False)``.
     """
     ny, nx = board.shape
     plan = plan_sharded_bits((ny, nx), 1, 1, False, False, budget)
