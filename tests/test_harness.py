@@ -198,6 +198,11 @@ def test_bench_cpu_end_to_end(capsys, monkeypatch):
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     assert rec["backend"] == "cpu"
     assert "not a TPU measurement" in rec["backend_fallback"]
+    # The fallback must point the reader at the committed chip record —
+    # and the path it names must actually exist in the repo.
+    assert "chip_record" in rec
+    named = rec["chip_record"].split()[0]
+    assert os.path.exists(os.path.join(REPO, named)), named
     assert "error" not in rec and "sharded_steady_cups" not in rec
 
 
