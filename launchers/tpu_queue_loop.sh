@@ -1,0 +1,63 @@
+#!/bin/bash
+# Serial TPU work queue with relay-wedge-safe recovery — the script form
+# of the pattern in .claude/skills/verify/SKILL.md: the single-tenant
+# chip behind the axon relay must see ONE process at a time, probes must
+# never be timeout-killed (a killed claim resets the relay's recovery
+# clock), and queued work must drain serially from the same loop that
+# probed. Run ONE instance; pin CPU everywhere else while it lives.
+#
+# Usage: tpu_queue_loop.sh QUEUE_DIR [LOG]
+#   QUEUE_DIR  holds numbered job scripts ([0-9]*.sh), run in lexical
+#              order; each moves to QUEUE_DIR/done/ on success. A failed
+#              job stays queued and the loop re-probes before retrying.
+#              The loop exits when no numbered jobs remain.
+#   LOG        append-only log (default /tmp/tpu_queue.log).
+#
+# Env knobs (tests stub the probe; operators rarely need these):
+#   TPUQ_PROBE_CMD  device probe command (default: a python jax.devices()
+#                   probe with NO timeout — a hang is fine, a kill is not)
+#   TPUQ_SLEEP      seconds between cycles after a failed probe or job
+#                   (default 900)
+#   TPUQ_SETTLE     seconds between consecutive chip processes (default
+#                   60 — back-to-back claims have wedged the relay)
+set -u
+QUEUE=${1:?usage: tpu_queue_loop.sh QUEUE_DIR [LOG]}
+LOG=${2:-/tmp/tpu_queue.log}
+PROBE=${TPUQ_PROBE_CMD:-python -c 'import jax; print(jax.devices())'}
+SLEEP=${TPUQ_SLEEP:-900}
+SETTLE=${TPUQ_SETTLE:-60}
+
+log() { echo "[$(date -u +%F' '%H:%M:%S)] $*" >>"$LOG"; }
+
+log "loop start (pid $$, queue $QUEUE)"
+while true; do
+    remaining=$(ls "$QUEUE"/[0-9]*.sh 2>/dev/null | wc -l)
+    if [ "$remaining" -eq 0 ]; then
+        log "queue empty; exiting"
+        exit 0
+    fi
+    log "probing devices"
+    if eval "$PROBE" >>"$LOG" 2>&1; then
+        log "chip up; draining queue"
+        drained=1
+        for job in "$QUEUE"/[0-9]*.sh; do
+            [ -e "$job" ] || continue
+            sleep "$SETTLE"
+            log "run $job"
+            if bash "$job" >>"$LOG" 2>&1; then
+                mkdir -p "$QUEUE/done" && mv "$job" "$QUEUE/done/"
+                log "done $job"
+            else
+                log "FAILED $job (kept queued); re-probing"
+                drained=0
+                break
+            fi
+        done
+        # A clean drain pass goes straight back to the (now empty)
+        # queue check — the long sleep is for broken states only.
+        [ "$drained" -eq 1 ] && continue
+    else
+        log "probe failed; sleep ${SLEEP}s"
+    fi
+    sleep "$SLEEP"
+done

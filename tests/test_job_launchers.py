@@ -77,3 +77,55 @@ def test_job_attention_zigzag_grad(tmp_path):
     assert "parity ok" in r.stderr
     lines = times.read_text().strip().splitlines()
     assert len(lines) == 1 and float(lines[0]) > 0
+
+def test_tpu_queue_loop_drains_and_exits(tmp_path):
+    """The wedge-safe chip-work queue (launchers/tpu_queue_loop.sh) with
+    a stubbed probe: numbered jobs run in order through one loop, move
+    to done/ on success, and the loop exits once the queue is empty."""
+    q = tmp_path / "queue"
+    q.mkdir()
+    (q / "01_a.sh").write_text("echo A >> %s/order\n" % tmp_path)
+    (q / "02_b.sh").write_text("echo B >> %s/order\n" % tmp_path)
+    log = tmp_path / "log"
+    r = subprocess.run(
+        [os.path.join(REPO, "launchers", "tpu_queue_loop.sh"),
+         str(q), str(log)],
+        env={**os.environ, "TPUQ_PROBE_CMD": "true", "TPUQ_SLEEP": "0",
+             "TPUQ_SETTLE": "0"},
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}\n{log.read_text()}"
+    assert (tmp_path / "order").read_text() == "A\nB\n"
+    assert sorted(p.name for p in (q / "done").iterdir()) == [
+        "01_a.sh", "02_b.sh"]
+    assert "queue empty; exiting" in log.read_text()
+
+
+def test_tpu_queue_loop_keeps_failed_job_queued(tmp_path):
+    """A failing job stays in the queue (the loop re-probes instead of
+    dropping chip work); no jobs after it run in that drain pass."""
+    import signal
+    import time
+
+    q = tmp_path / "queue"
+    q.mkdir()
+    (q / "01_bad.sh").write_text("exit 1\n")
+    (q / "02_never.sh").write_text("echo RAN >> %s/ran\n" % tmp_path)
+    log = tmp_path / "log"
+    p = subprocess.Popen(
+        [os.path.join(REPO, "launchers", "tpu_queue_loop.sh"),
+         str(q), str(log)],
+        env={**os.environ, "TPUQ_PROBE_CMD": "true", "TPUQ_SLEEP": "1",
+             "TPUQ_SETTLE": "0"})
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if log.exists() and "FAILED" in log.read_text():
+                break
+            time.sleep(0.2)
+    finally:
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=10)
+    text = log.read_text()
+    assert "FAILED" in text and str(q / "01_bad.sh") in text
+    assert (q / "01_bad.sh").exists()          # kept queued
+    assert not (tmp_path / "ran").exists()     # later job not reached
