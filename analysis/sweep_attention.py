@@ -13,16 +13,20 @@ next call's queries so the chain cannot be elided — and difference a
 longer chain (R=9 fwd, R=3 bwd) against R=1, best-of-3 each. TFLOP/s counts 2*h*n^2*d (QK^T + PV, causal
 half). Emits a CSV:
 
-    seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine
+    seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,hop_engine
 
 where `bwd_sec` times one FULL grad step (forward + backward per chain
 link — a backward can't run without its forward), `bwd_tflops` uses
 the matching fwd+bwd = 3.5x fwd accounting, and `engine` records which
 attention engine+block configuration (e.g. `pallas:b1024`, with a
 `:kvxG` suffix for the GQA expand dispatch, or `jnp`) produced the
-row — a mid-sweep fallback is visible in the artifact. `--kv-heads`
-sweeps a GQA/MQA configuration instead (TFLOP/s still counts the
-q-heads, which carry the compute).
+row — a mid-sweep fallback is visible in the artifact. `hop_engine`
+records what each K/V hop of a multi-device ring over the same global
+operands would dispatch (`context.ring_hop_engine_for`; `local:`-
+prefixed on a 1-device mesh) — provenance for relating these
+single-chip rates to the ring's per-hop engine, not a timing of the
+ring itself. `--kv-heads` sweeps a GQA/MQA configuration instead
+(TFLOP/s still counts the q-heads, which carry the compute).
 
 Usage: python analysis/sweep_attention.py [--out results/attention/attention_tpu.csv]
 """
@@ -170,7 +174,8 @@ def main(argv=None) -> int:
 
     from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
 
-    rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine"]
+    rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,"
+            "hop_engine"]
 
     def flush() -> None:
         write_csv_rows(args.out, rows)
@@ -188,6 +193,7 @@ def main(argv=None) -> int:
             # mid-sweep fallback or per-shape downgrade must be visible
             # in the artifact, not only on stderr.
             engine = context.flash_engine_for(*qkv)
+            hop = context.ring_hop_engine_for(*qkv, causal=True)
             fwd, diff_f = marginal(fwd_chain, qkv)
             if n <= args.bwd_max:
                 # grad runs fwd + bwd; standard fwd+bwd accounting is
@@ -197,9 +203,9 @@ def main(argv=None) -> int:
                 bwd, diff_b = marginal(bwd_chain, qkv, r2=3)
                 return (f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},"
                         f"{bwd:.5f},{3.5 * flops / bwd / 1e12:.1f},"
-                        f"{int(diff_f and diff_b)},{engine}")
+                        f"{int(diff_f and diff_b)},{engine},{hop}")
             return (f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},,,"
-                    f"{int(diff_f)},{engine}")
+                    f"{int(diff_f)},{engine},{hop}")
 
         try:
             rows.append(point())

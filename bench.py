@@ -247,8 +247,13 @@ def main(argv=None) -> int:
                for _ in range(3)]
         # Shape-aware provenance: the engine the timed 32k operands
         # actually dispatch to (a block override that doesn't divide
-        # 32k routes them to jnp even when the gate passed on pallas).
+        # 32k routes them to jnp even when the gate passed on pallas),
+        # plus the engine each K/V hop of a ring over the same operands
+        # would run (the multi-device flagship path — "jnp" means the
+        # fold oracle, a pallas stamp means the per-hop kernel).
         sharded["attention_engine"] = context.flash_engine_for(*qkv)
+        sharded["attention_hop_engine"] = context.ring_hop_engine_for(
+            *qkv, causal=True)
 
         @jax.jit
         def chain(q, k, v, r):

@@ -23,7 +23,10 @@
 set -u
 QUEUE=${1:?usage: tpu_queue_loop.sh QUEUE_DIR [LOG]}
 LOG=${2:-/tmp/tpu_queue.log}
-PROBE=${TPUQ_PROBE_CMD:-python -c 'import jax; print(jax.devices())'}
+# The inner quotes must survive into the variable (the probe is run via
+# eval): an unquoted default would hand eval the bare words and die on
+# the parenthesis before ever reaching the chip.
+PROBE=${TPUQ_PROBE_CMD:-"python -c 'import jax; print(jax.devices())'"}
 SLEEP=${TPUQ_SLEEP:-900}
 SETTLE=${TPUQ_SETTLE:-60}
 
@@ -36,6 +39,9 @@ while true; do
         log "queue empty; exiting"
         exit 0
     fi
+    # The probe is itself a chip claim: honor the settle gap before it,
+    # same as between jobs (back-to-back claims have wedged the relay).
+    sleep "$SETTLE"
     log "probing devices"
     if eval "$PROBE" >>"$LOG" 2>&1; then
         log "chip up; draining queue"
@@ -54,8 +60,9 @@ while true; do
             fi
         done
         # A clean drain pass goes straight back to the (now empty)
-        # queue check — the long sleep is for broken states only.
-        [ "$drained" -eq 1 ] && continue
+        # queue check — the long sleep is for broken states only. Settle
+        # first: if jobs remain the next cycle re-probes immediately.
+        [ "$drained" -eq 1 ] && { sleep "$SETTLE"; continue; }
     else
         log "probe failed; sleep ${SLEEP}s"
     fi

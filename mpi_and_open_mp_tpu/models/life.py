@@ -321,7 +321,7 @@ class LifeSim:
         def make_smapped(kk: int):
             # check_vma=False: the Pallas per-shard kernel can't annotate
             # varying-mesh-axes on its out_shape; the specs are authoritative.
-            return jax.shard_map(
+            return mesh_lib.shard_map(
                 lambda b: self._local_fused_step(b, kk),
                 mesh=self.mesh,
                 in_specs=spec,
@@ -431,7 +431,7 @@ class LifeSim:
             )
             return bitlife.unpack_board_exact(q).astype(dtype)
 
-        smapped = jax.shard_map(
+        smapped = mesh_lib.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(spec, P()),

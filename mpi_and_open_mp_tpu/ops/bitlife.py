@@ -753,12 +753,12 @@ def life_run_frame_bits(
     steady — the any-shape path at scale, with a
     one-HBM-pass-per-128-steps traffic bound the XLA roll loop loses
     once its intermediates spill through HBM (653 vs 242 µs/step at
-    16384², ``bit_step_xla`` docstring). An r04 probe recorded "37.0 vs
-    32.6 µs/step" for frame-vs-XLA at this size; 32.6 µs/step at 10⁸
-    cells would be 3.1 Tcups — above the 2.24 peak of the whole curve —
-    so that pair is considered a measurement error (superseded here; a
-    differenced A/B re-probe is queued). Gate callers on
-    ``plan_sharded_bits(shape, 1, 1, False, False)``.
+    16384², ``bit_step_xla`` docstring). An earlier r04 probe recorded
+    "37.0 vs 32.6 µs/step" for frame-vs-XLA at this size; 32.6 µs/step
+    at 10⁸ cells would be 3.1 Tcups — above the 2.24 peak of the whole
+    curve — so that pair was a measurement error (un-differenced timing
+    through the relay), and the r05 differenced re-record above replaces
+    it. Gate callers on ``plan_sharded_bits(shape, 1, 1, False, False)``.
     """
     ny, nx = board.shape
     plan = plan_sharded_bits((ny, nx), 1, 1, False, False, budget)

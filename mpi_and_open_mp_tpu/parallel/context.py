@@ -33,6 +33,7 @@ Both are differentiable (static ring trip count => ``fori_loop`` lowers to
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 import os
@@ -43,7 +44,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
-from mpi_and_open_mp_tpu.parallel.halo import ring_perm
+from mpi_and_open_mp_tpu.parallel.halo import axis_size, ring_perm
 
 AXIS_SP = "sp"
 
@@ -224,7 +225,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool,
     per shard — O(seq·d/p) — and the backward re-rotates K/V around the
     ring, recomputing each block from the saved row statistics.
     """
-    p = lax.axis_size(axis)
+    p = axis_size(axis)
     if p == 1:
         # A 1-device ring is just full local attention (under EITHER
         # layout: the p=1 zigzag order is the identity); the
@@ -251,8 +252,18 @@ def _ring_forward(axis: str, causal: bool, layout: str, q, k, v):
     device about half a full-block per hop, versus the contiguous split
     where hop wall-clock is set by whichever device's block is
     unskipped (the straggler)."""
-    p = lax.axis_size(axis)
-    idx = lax.axis_index(axis)
+    p = axis_size(axis)
+    # TPU-eligible hop shapes take the per-hop Pallas engine instead of
+    # the jnp fold below (which remains the oracle and the fallback) —
+    # same ring schedule, flash-kernel hops, online-softmax merge.
+    hop_plan = _ring_hop_plan(q, k, v, causal, layout)
+    if hop_plan is not None:
+        return _ring_forward_hopflash(axis, causal, p, q, k, v, hop_plan)
+    # Non-causal folds build no masks, so every consumer of the axis
+    # index is dead code — and jax 0.4.37's shard_map does not DCE the
+    # resulting bare partition_id, which the SPMD partitioner then
+    # rejects. Only materialise the index when a mask can consume it.
+    idx = lax.axis_index(axis) if causal else 0
     nl, d = q.shape[1:]
     hkv = k.shape[0]
     g = q.shape[0] // hkv
@@ -466,8 +477,11 @@ def _ring_flash_bwd(axis: str, causal: bool, layout: str, res, do):
     come out group-summed, ``dq`` is unfolded at the end.
     """
     q, k, v, o, L = res
-    p = lax.axis_size(axis)
-    idx = lax.axis_index(axis)
+    p = axis_size(axis)
+    # See the forward's note: keep the axis index out of the non-causal
+    # trace (its consumers are all dead there and 0.4.37's shard_map
+    # leaves the bare partition_id for the SPMD partitioner to reject).
+    idx = lax.axis_index(axis) if causal else 0
     nl, d = q.shape[1:]
     hkv = k.shape[0]
     g = q.shape[0] // hkv
@@ -636,6 +650,36 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 # the kernel ever disagrees with the dense oracle).
 _TPU_FLASH = os.environ.get("MOMP_TPU_FLASH", "1") != "0"
 
+# MOMP_PALLAS_INTERPRET=1 routes Pallas-eligible shapes through the
+# bundled kernel in Pallas interpret mode on ANY backend — the CPU-mesh
+# test rig for kernel-inside-shard_map paths (tests/conftest.py pins 8
+# virtual CPU devices; nothing here needs hardware). Interpret
+# eligibility is narrower than the chip's: jax 0.4.37's interpret-mode
+# discharge rule breaks on the kernel's scratch branch (block_k <
+# kv_seq) and on the kernel's own backward, so only block == seq
+# forwards qualify — exactly what the per-hop ring engine runs (our own
+# custom_vjp supplies the ring backward; the kernel's vjp is never
+# entered there).
+_PALLAS_INTERPRET = os.environ.get("MOMP_PALLAS_INTERPRET", "0") == "1"
+
+
+@contextlib.contextmanager
+def _pallas_interpret_calls(fa):
+    """Trace-time patch turning every ``pallas_call`` the bundled kernel
+    makes into an interpret-mode call (jax 0.4.37 has no global
+    interpret switch). A no-op unless ``_PALLAS_INTERPRET`` is set.
+    Callers flipping the flag at runtime must ``jax.clear_caches()`` —
+    the flag is not a jit cache key."""
+    if not _PALLAS_INTERPRET:
+        yield
+        return
+    orig = fa.pl.pallas_call
+    fa.pl.pallas_call = functools.partial(orig, interpret=True)
+    try:
+        yield
+    finally:
+        fa.pl.pallas_call = orig
+
 # Chip-validated uniform block edges, best first; the auto dispatch
 # picks the largest that divides the sequence AND leaves at least
 # _MIN_GRID programs per grid axis (gate + recorders then exercise that
@@ -647,14 +691,15 @@ _AUTO_BLOCKS = (1024, 512, 256, 128)
 # kernel's vjp collapses to 25.8 TFLOP/s grad (79.5 fwd); b=512 (16x16)
 # measures 113.4 grad / 97.9 fwd — the backward needs >= ~16 programs
 # per axis to fill the chip's pipeline. 16k+ at b=1024 already satisfy
-# the floor (137-147 fwd measured), so only shorter sequences change.
+# the floor (137-147 fwd measured). The floor applies at EVERY edge:
+# 2k-4k sequences step down to 128/256 blocks for a full grid rather
+# than keep the largest-dividing block with a starved 2-4 program grid
+# (the 8k collapse extrapolated per-edge; the per-hop ring engine puts
+# exactly these short local blocks on the kernel, so starved grids are
+# no longer a corner case). A sequence too short to satisfy the floor
+# with ANY edge (< 2048) takes the largest fitting block — at that size
+# the kernel call is latency- not occupancy-bound.
 _MIN_GRID = 16
-
-# The floor only ever chooses between chip-measured edges (512/1024).
-# Sequences too short to form a _MIN_GRID-deep grid of >= this edge
-# (n < 8192) keep the plain largest-dividing choice rather than
-# extrapolate the 8k finding down to unmeasured 128/256 grids.
-_FLOOR_MIN_EDGE = 512
 
 
 def tpu_flash_engine() -> str:
@@ -682,8 +727,7 @@ def flash_engine_for(q, k, v) -> str:
     plan = _flash_dispatch_plan(q, k, v)
     if plan is None:
         return "jnp"
-    kind, blk, groups = plan
-    return f"pallas:b{blk}" + (f":kvx{groups}" if kind == "expand" else "")
+    return _plan_stamp(plan)
 
 
 def disable_tpu_flash() -> None:
@@ -731,9 +775,10 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     """
     import numpy as np
 
-    global _FORCED_BLOCK
+    global _FORCED_BLOCK, _FORCED_BLOCK_BWD
     hkv = kv_heads or heads
     forced = 0
+    forced_bwd = 0
     steer_jnp = False
     if for_seq is not None and tpu_flash_engine() == "pallas":
         # Route exactly as the timed shape will: same plan function,
@@ -744,7 +789,7 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
         plan = (_flash_dispatch_plan(sq, skv, skv)
                 if for_seq > _Q_CHUNK else None)
         if plan is not None:
-            forced = plan[1]
+            forced, forced_bwd = plan[1], plan[2]
         else:
             # The timed shape is jnp-bound (no block divides it, an
             # override doesn't, or its GQA expansion is over budget):
@@ -763,8 +808,13 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     # when steering jnp-ward: the round-up would put an overridden
     # block's multiple right back on the Pallas grid.)
     blk = _flash_block_override() or forced
+    bwd = _flash_block_override_bwd() or forced_bwd or blk
     if blk and not steer_jnp:
-        n = -(-n // blk) * blk
+        # With the backward edge decoupled, the gate sequence must be a
+        # multiple of BOTH effective edges (the kernel rejects either
+        # non-divisor), so round up to their lcm.
+        m = math.lcm(blk, bwd)
+        n = -(-n // m) * m
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((heads, n, dim)), jnp.float32)
     k, v = (jnp.asarray(rng.standard_normal((hkv, n, dim)), jnp.float32)
@@ -813,6 +863,7 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
     # (not the bare flag): off-TPU a jnp failure would otherwise trigger
     # a pointless cache drop and an identical second jnp run.
     _FORCED_BLOCK = forced
+    _FORCED_BLOCK_BWD = forced_bwd
     try:
         ok = attempt()
         if not ok and tpu_flash_engine() == "pallas" and not steer_jnp:
@@ -820,36 +871,50 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
             ok = attempt()
     finally:
         _FORCED_BLOCK = 0
+        _FORCED_BLOCK_BWD = 0
     # When the steer aimed the gate at the jnp engine, that IS the
     # engine the for_seq shape will use — report it, not the flag.
     return ok, ("jnp" if steer_jnp else tpu_flash_engine()), notes
 
 
-def _flash_block_override() -> int:
-    """The validated ``MOMP_FLASH_BLOCK`` value (0 = kernel default).
-    One shared parse for the routing predicate, the dispatch, and the
-    parity gate, so they cannot disagree on the effective block — and a
-    typo'd knob fails loudly with its own name, not as an opaque error
-    from some later dispatch."""
-    raw = os.environ.get("MOMP_FLASH_BLOCK", "").strip()
+def _parse_block_env(name: str) -> int:
+    """Validated block-edge env knob (0 = unset). One shared parse for
+    the routing predicate, the dispatch, and the parity gate, so they
+    cannot disagree on the effective block — and a typo'd knob fails
+    loudly with its own name, not as an opaque error from some later
+    dispatch."""
+    raw = os.environ.get(name, "").strip()
     if not raw:
         return 0
     try:
         b = int(raw)
     except ValueError:
-        raise ValueError(
-            f"MOMP_FLASH_BLOCK={raw!r} is not an integer") from None
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
     if b < 0 or (b and (b < 128 or b % 128)):
         raise ValueError(
-            f"MOMP_FLASH_BLOCK={b} must be 0 or a multiple of 128 >= 128")
+            f"{name}={b} must be 0 or a multiple of 128 >= 128")
     return b
 
 
-# Gate-time pin of the auto block choice (module-internal; see
-# gated_parity_check): lets the small-sequence parity gate run the very
+def _flash_block_override() -> int:
+    """The ``MOMP_FLASH_BLOCK`` pin: all blocks (forward, and backward
+    too unless the backward knob overrides it)."""
+    return _parse_block_env("MOMP_FLASH_BLOCK")
+
+
+def _flash_block_override_bwd() -> int:
+    """The ``MOMP_FLASH_BLOCK_BWD`` pin: the eight dq/dkv blocks only
+    (:func:`_flash_bwd_block_for`)."""
+    return _parse_block_env("MOMP_FLASH_BLOCK_BWD")
+
+
+# Gate-time pins of the auto block choices (module-internal; see
+# gated_parity_check): let the small-sequence parity gate run the very
 # block configuration a larger timed sequence will dispatch, since the
 # dense oracle is O(n^2) and cannot be evaluated at the timed length.
+# The backward edge is pinned separately (decoupled dispatch).
 _FORCED_BLOCK = 0
+_FORCED_BLOCK_BWD = 0
 
 # b*d budget for the auto choice, anchored at the chip-validated
 # (b=1024, d=128) point: 2048*128 failed to compile (VMEM), so wider
@@ -865,44 +930,67 @@ def _block_pin() -> int:
 
 
 def _flash_block_for(n: int, d: int = 128) -> int:
-    """Effective Pallas block edge for a ``(seq=n, head_dim=d)``
+    """Effective Pallas FORWARD block edge for a ``(seq=n, head_dim=d)``
     dispatch: the pin (env override / gate force) if set, else the
     largest chip-validated block (``_AUTO_BLOCKS``) dividing ``n``
     within the ``b*d <= _BLOCK_BUDGET`` footprint that keeps the grid
     at least ``_MIN_GRID`` programs per axis (short sequences starve
-    the kernel's backward below that — see the ``_MIN_GRID`` note),
-    considering only edges >= ``_FLOOR_MIN_EDGE`` for the floor; if
-    none qualifies, the largest fitting block regardless. 0 = no block
-    fits (the shape is then jnp-engine territory)."""
+    the kernel below that — see the ``_MIN_GRID`` note); if no edge
+    satisfies the floor, the largest fitting block regardless. 0 = no
+    block fits (the shape is then jnp-engine territory)."""
     b = _block_pin()
     if b:
         return b
     fits = [b for b in _AUTO_BLOCKS
             if b * d <= _BLOCK_BUDGET and n % b == 0]
     for b in fits:
-        if b >= _FLOOR_MIN_EDGE and n >= _MIN_GRID * b:
+        if n >= _MIN_GRID * b:
             return b
     return fits[0] if fits else 0
 
 
+def _flash_bwd_block_for(n: int, d: int = 128) -> int:
+    """Effective Pallas BACKWARD block edge (the eight dq/dkv blocks).
+    Decoupled from the forward's: ``MOMP_FLASH_BLOCK_BWD`` (or the
+    gate's backward force) pins it independently, so a chip session can
+    sweep e.g. a b1024 forward against a b512 backward — the backward
+    is the grid-occupancy-sensitive side (``_MIN_GRID`` note) and its
+    best edge need not match the forward's. Unpinned, it follows the
+    forward choice (a single ``MOMP_FLASH_BLOCK`` still pins all eight
+    blocks, exactly the pre-decoupling behaviour); the auto edges
+    coincide until a chip sweep separates them."""
+    b = _flash_block_override_bwd() or _FORCED_BLOCK_BWD
+    if b:
+        return b
+    return _flash_block_for(n, d)
+
+
 def _pallas_flash_eligible(q, k, v) -> bool:
     """Static (trace-time) routing predicate for the bundled Pallas TPU
-    kernel taking the operands DIRECTLY: TPU backend, equal head counts
-    (GQA shapes go through :func:`_flash_dispatch_plan`'s expand form
-    instead), a validated block edge that divides the sequence within
-    the ``b*d`` footprint budget (:func:`_flash_block_for`; a pinned
-    block tightens divisibility to its own multiple), MXU-width head
-    dim, and a dtype the MXU takes directly."""
+    kernel taking the operands DIRECTLY: TPU backend (or interpret mode
+    on any backend), equal head counts (GQA shapes go through
+    :func:`_flash_dispatch_plan`'s expand form instead), validated
+    forward AND backward block edges that divide the sequence within
+    the ``b*d`` footprint budget (:func:`_flash_block_for` /
+    :func:`_flash_bwd_block_for`; a pinned block tightens divisibility
+    to its own multiple), MXU-width head dim, and a dtype the MXU takes
+    directly. Interpret mode additionally requires block == seq (jax
+    0.4.37's interpret discharge rule breaks on the scratch branch)."""
     if not _TPU_FLASH:
         return False
-    try:
-        if jax.default_backend() != "tpu":
+    if not _PALLAS_INTERPRET:
+        try:
+            if jax.default_backend() != "tpu":
+                return False
+        except RuntimeError:  # no backend at all (early init)
             return False
-    except RuntimeError:  # no backend at all (early init)
-        return False
     h, n, d = q.shape
     blk = _flash_block_for(n, d)
-    return (k.shape[0] == h and d % 128 == 0 and blk != 0 and n % blk == 0
+    bwd = _flash_bwd_block_for(n, d)
+    if _PALLAS_INTERPRET and not (blk == n and bwd == n):
+        return False
+    return (k.shape[0] == h and d % 128 == 0
+            and blk != 0 and n % blk == 0 and bwd != 0 and n % bwd == 0
             and q.dtype in (jnp.float32, jnp.bfloat16)
             and k.dtype == q.dtype and v.dtype == q.dtype)
 
@@ -916,24 +1004,183 @@ _GQA_EXPAND_BYTES = 2 << 30
 
 def _flash_dispatch_plan(q, k, v):
     """How (if at all) these operands reach the Pallas kernel:
-    ``("direct", blk, 1)``, ``("expand", blk, groups)``, or ``None``
-    (the jnp engine). GQA/MQA shapes whose broadcast K/V fit
+    ``("direct", blk, blk_bwd, 1)``, ``("expand", blk, blk_bwd,
+    groups)``, or ``None`` (the jnp engine). ``blk`` is the forward
+    block edge, ``blk_bwd`` the (independently pinnable) edge of the
+    eight dq/dkv blocks. GQA/MQA shapes whose broadcast K/V fit
     ``_GQA_EXPAND_BYTES`` are dispatched by expanding — chip-measured
     (32k, 8q/2kv, causal bf16, two runs): expand+kernel 130.7-134.1
     fwd / 100.0-106.4 grad TFLOP/s vs 48.4 / 47.5 for the folded jnp
     path, i.e. the repeat's HBM cost is a ~2.7x win. The gradient through ``jnp.repeat`` sums
     per-group dk/dv exactly as the folded path does."""
-    if _pallas_flash_eligible(q, k, v):
-        return ("direct", _flash_block_for(q.shape[1], q.shape[2]), 1)
     h, n, d = q.shape
+    if _pallas_flash_eligible(q, k, v):
+        return ("direct", _flash_block_for(n, d), _flash_bwd_block_for(n, d), 1)
     hkv = k.shape[0]
     if hkv and h % hkv == 0 and h > hkv:
         ek = jax.ShapeDtypeStruct((h, n, d), k.dtype)
         ev = jax.ShapeDtypeStruct((h, n, d), v.dtype)
         if (2 * h * n * d * q.dtype.itemsize <= _GQA_EXPAND_BYTES
                 and _pallas_flash_eligible(q, ek, ev)):
-            return ("expand", _flash_block_for(n, d), h // hkv)
+            return ("expand", _flash_block_for(n, d),
+                    _flash_bwd_block_for(n, d), h // hkv)
     return None
+
+
+def _plan_stamp(plan) -> str:
+    """Provenance string for a dispatch plan: ``pallas:b<blk>`` plus
+    ``:bw<blk_bwd>`` when the backward edge differs from the forward's
+    and ``:kvx<groups>`` for the GQA expand form — the exact
+    configuration recorders must gate and stamp."""
+    kind, blk, bwd, groups = plan
+    stamp = f"pallas:b{blk}"
+    if bwd != blk:
+        stamp += f":bw{bwd}"
+    if kind == "expand":
+        stamp += f":kvx{groups}"
+    return stamp
+
+
+# The multi-device ring's per-hop engine: run the Pallas flash kernel on
+# each arriving K/V block instead of the jnp `_block_update` fold
+# (chip-measured 132-147 vs 47-49 TFLOP/s — see the `_TPU_FLASH` note),
+# and merge hops with the exact online-softmax combine. MOMP_RING_HOP=0
+# pins the ring to the jnp fold (which remains the CPU/interpret oracle
+# and the fallback for hop shapes the kernel doesn't take).
+_RING_HOP = os.environ.get("MOMP_RING_HOP", "1") != "0"
+
+
+def _ring_hop_plan(q, k, v, causal: bool, layout: str):
+    """Dispatch plan for the per-hop Pallas ring engine, or ``None``
+    (the jnp fold). Operands are the PER-SHARD ``(h, n_local, d)``
+    blocks, so eligibility — block edges, GQA expand budget — is judged
+    at hop granularity. Causal zigzag stays on the jnp fold: its live
+    quarter-block masks aren't expressible with the kernel's static
+    causal flag (the contiguous ring needs only the flag: hop 0 is the
+    diagonal triangle, every other unskipped hop is fully unmasked)."""
+    if not _RING_HOP:
+        return None
+    if causal and layout == "zigzag":
+        return None
+    return _flash_dispatch_plan(q, k, v)
+
+
+def _merge_partials(o1, L1, o2, L2):
+    """Online-softmax combine of two NORMALISED attention partials over
+    disjoint key sets: ``L = logaddexp(L1, L2)``, ``o = o1·exp(L1-L) +
+    o2·exp(L2-L)``. Exact (it is the algebraic merge of the two
+    softmaxes' numerators and denominators) and associative, so hops
+    may fold in any order. ``o`` rows ``(h, n, d)``, ``L`` ``(h, n)``,
+    all float32."""
+    L = jnp.logaddexp(L1, L2)
+    w1 = jnp.exp(L1 - L)[..., None]
+    w2 = jnp.exp(L2 - L)[..., None]
+    return o1 * w1 + o2 * w2, L
+
+
+def _hop_flash_block(q, kb, vb, causal: bool, blk: int, groups: int):
+    """One hop's attention through the bundled Pallas kernel: the
+    NORMALISED partial output and its per-row logsumexp ``L = m +
+    log(l)`` of the scaled scores — the partial :func:`_merge_partials`
+    combines, both float32. Calls the kernel's forward impl directly
+    with ``save_residuals=True`` (the public ``fa._flash_attention``
+    custom_vjp refuses residuals in its fwd): safe here because the
+    ring's own ``custom_vjp`` wraps the whole trip, so the kernel's vjp
+    is never entered — the travelling-dk/dv ``_ring_flash_bwd`` keeps
+    the backward contract. GQA hops broadcast K/V locally per hop
+    (plan-budgeted); the ppermutes still carry the un-expanded
+    ``(hkv, ...)`` blocks."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    if groups > 1:
+        kb, vb = _repeat_heads(kb, vb, groups)
+    d = q.shape[-1]
+    with _pallas_interpret_calls(fa):
+        o, l, m = fa._flash_attention_impl(
+            q[None], kb[None], vb[None], None, None, True, causal,
+            1.0 / math.sqrt(d), block_b=1, block_q=blk,
+            block_k_major=blk, block_k=blk, debug=False)
+    L = m[0] + jnp.log(l[0])
+    return o[0].astype(jnp.float32), L.astype(jnp.float32)
+
+
+def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
+    """The rotate-and-fold forward with the Pallas kernel as the per-hop
+    engine (contiguous layout; :func:`_ring_hop_plan` gated). Same ring
+    schedule as the jnp fold — double-buffered ppermutes outside the
+    causal ``cond`` — but each hop runs the flash kernel to a
+    normalised ``(o, L)`` partial and hops merge via
+    :func:`_merge_partials` instead of carrying raw ``(o, m, l)``
+    state. Hop 0 is the resident diagonal block — the one hop whose
+    causal mask is the standard triangle in local coordinates, i.e. the
+    kernel's static ``causal`` flag; every later unskipped hop
+    (``src < idx``) is fully unmasked. Returns ``(o, L)`` with ``L`` in
+    the folded GQA layout ``_ring_flash_bwd`` consumes."""
+    idx = lax.axis_index(axis) if causal else 0
+    hkv = k.shape[0]
+    g = q.shape[0] // hkv
+    _, blk, _, groups = plan
+    perm = ring_perm(p, 1)
+
+    # Issue the first rotation before the diagonal block's kernel call
+    # (the jnp fold's double-buffering, same latency-hiding pairing).
+    k1 = lax.ppermute(k, axis, perm)
+    v1 = lax.ppermute(v, axis, perm)
+    state = _hop_flash_block(q, k, v, causal, blk, groups)
+
+    def fold(j, state, kb, vb):
+        # After j forward rotations this block originated on ring
+        # position (idx - j) mod p — never the diagonal for j >= 1, so
+        # it is either fully unmasked (src < idx, or any hop when
+        # non-causal) or entirely in the future and skipped. The
+        # ppermutes stay outside the cond (collectives inside a
+        # per-device branch would deadlock the ring).
+        def take(s):
+            o2, L2 = _hop_flash_block(q, kb, vb, False, blk, groups)
+            return _merge_partials(s[0], s[1], o2, L2)
+
+        if not causal:
+            return take(state)
+        src = (idx - j) % p
+        return lax.cond(src < idx, take, lambda s: s, state)
+
+    def hop(j, carry):
+        state, kb, vb = carry
+        kb_next = lax.ppermute(kb, axis, perm)
+        vb_next = lax.ppermute(vb, axis, perm)
+        state = fold(j, state, kb, vb)
+        return state, kb_next, vb_next
+
+    state, kb, vb = lax.fori_loop(1, p - 1, hop, (state, k1, v1))
+    o, L = fold(p - 1, state, kb, vb)
+    # The kernel emits per-q-head rows; the ring backward consumes the
+    # folded GQA layout (row r <-> position r // g, group r % g).
+    return o.astype(q.dtype), _fold_groups(L, hkv, g)
+
+
+def ring_hop_engine_for(q, k, v, *, p: int | None = None,
+                        causal: bool = True,
+                        layout: str = "contiguous") -> str:
+    """Shape-aware provenance for the MULTI-DEVICE ring fold: the engine
+    each K/V hop of a ``ring_attention`` over these GLOBAL operands
+    will run — a ``pallas:b…`` stamp (per-hop kernel) or ``"jnp"`` (the
+    fold oracle). ``p`` defaults to the local device count (what
+    ``ring_attention``'s default mesh uses). A 1-device ring never
+    enters the ring body; its local engine is reported as
+    ``"local:<flash_engine_for stamp>"``. Recorders publishing ring
+    timings must stamp artifacts with this, exactly as single-device
+    recorders stamp :func:`flash_engine_for`."""
+    if p is None:
+        p = len(jax.devices())
+    h, n, d = q.shape
+    if p == 1:
+        return "local:" + flash_engine_for(q, k, v)
+    nl = n // p
+    sq = jax.ShapeDtypeStruct((h, nl, d), q.dtype)
+    sk = jax.ShapeDtypeStruct((k.shape[0], nl, d), k.dtype)
+    sv = jax.ShapeDtypeStruct((v.shape[0], nl, d), v.dtype)
+    plan = _ring_hop_plan(sq, sk, sv, causal, layout)
+    return "jnp" if plan is None else _plan_stamp(plan)
 
 
 def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:
@@ -951,16 +1198,18 @@ def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:
     recorders' parity gates cover whatever value is in effect)."""
     from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
-    # eligibility ensured a block exists and seq % b == 0
+    # eligibility ensured both edges exist and divide seq
     b = _flash_block_for(q.shape[1], q.shape[2])
+    bw = _flash_bwd_block_for(q.shape[1], q.shape[2])
     blocks = fa.BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
-        block_q_major_dkv=b, block_k_major_dkv=b,
-        block_k_dkv=b, block_q_dkv=b,
-        block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
-    out = fa.flash_attention(
-        q[None], k[None], v[None], causal=causal,
-        sm_scale=1.0 / math.sqrt(q.shape[-1]), block_sizes=blocks)
+        block_q_major_dkv=bw, block_k_major_dkv=bw,
+        block_k_dkv=bw, block_q_dkv=bw,
+        block_k_major_dq=bw, block_k_dq=bw, block_q_dq=bw)
+    with _pallas_interpret_calls(fa):
+        out = fa.flash_attention(
+            q[None], k[None], v[None], causal=causal,
+            sm_scale=1.0 / math.sqrt(q.shape[-1]), block_sizes=blocks)
     return out[0].astype(q.dtype)
 
 
@@ -1011,7 +1260,7 @@ def _attention_chunked(q, k, v, causal: bool) -> jnp.ndarray:
             q, *_repeat_heads(k, v, h // k.shape[0]), causal=causal)
     plan = _flash_dispatch_plan(q, k, v)
     if plan is not None:
-        kind, _, groups = plan
+        kind, _, _, groups = plan
         if kind == "expand":
             k, v = _repeat_heads(k, v, groups)
         return _pallas_flash(q, k, v, causal)
@@ -1273,7 +1522,7 @@ def _sharded_attention_jit(q, k, v, *, local_fn, mesh: Mesh, axis: str,
     body = functools.partial(local_fn, axis=axis, causal=causal,
                              **local_kwargs)
     spec = _seq_spec(axis)
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

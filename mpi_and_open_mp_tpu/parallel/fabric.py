@@ -28,7 +28,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
-from mpi_and_open_mp_tpu.parallel.halo import ring_perm
+from mpi_and_open_mp_tpu.parallel.halo import axis_size, ring_perm
 
 # Message sizes in bytes: 10^0 .. 10^6, matching mpi_send_recv.c:22.
 DEFAULT_SIZES = tuple(10**k for k in range(7))
@@ -39,10 +39,10 @@ def _ring_shift_loop(buf: jnp.ndarray, *, axis: str, reps: int, mesh: Mesh):
     """``reps`` sequential one-hop ring shifts of each device's buffer."""
 
     def shifted(b):
-        p = lax.axis_size(axis)
+        p = axis_size(axis)
         return lax.ppermute(b, axis, ring_perm(p, 1))
 
-    smapped = jax.shard_map(
+    smapped = mesh_lib.shard_map(
         lambda b: lax.fori_loop(0, reps, lambda _, x: shifted(x), b),
         mesh=mesh,
         in_specs=P(axis),

@@ -34,8 +34,15 @@ def ring_perm(p: int, shift: int = 1) -> list[tuple[int, int]]:
     return [(i, (i + shift) % p) for i in range(p)]
 
 
-def _axis_size(axis_name: str) -> int:
-    return lax.axis_size(axis_name)
+def axis_size(axis_name: str) -> int:
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    # jax <= 0.4.37 has no lax.axis_size; core.axis_frame(name) IS the
+    # static size there (trace_ctx.axis_env.axis_size).
+    return jax.core.axis_frame(axis_name)
+
+
+_axis_size = axis_size
 
 
 def halo_pad_y(block: jnp.ndarray, axis_name: str = "y", depth: int = 1) -> jnp.ndarray:

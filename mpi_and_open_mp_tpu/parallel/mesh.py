@@ -18,15 +18,49 @@ dimension (axis 1).
 from __future__ import annotations
 
 import jax
-from jax.sharding import AxisType, Mesh
-
-AXIS_Y = "y"
-AXIS_X = "x"
+from jax.sharding import Mesh
 
 # Classic GSPMD propagation (Auto) rather than sharding-in-types (Explicit,
 # the jax>=0.9 make_mesh default): the roll-based global step relies on XLA
 # propagating shardings through circular shifts of arbitrary (uneven) sizes.
-_AUTO = AxisType.Auto
+# ``AxisType`` only exists from jax 0.4.38ish onward (and ``make_mesh`` only
+# grew the ``axis_types`` kwarg alongside it); on older jax every mesh axis
+# IS implicitly Auto, so the portable form is: pass ``axis_types`` only when
+# the installed jax knows the enum, otherwise rely on the implicit default.
+try:  # pragma: no cover - exercised as one branch per installed jax
+    from jax.sharding import AxisType
+except ImportError:  # jax <= 0.4.37: Auto semantics are the only semantics
+    AxisType = None
+
+AXIS_Y = "y"
+AXIS_X = "x"
+
+# ``jax.shard_map`` is also a recent promotion: on jax <= 0.4.37 it lives at
+# ``jax.experimental.shard_map.shard_map`` and spells the replication check
+# ``check_rep`` instead of ``check_vma``. Every shard_map in this codebase
+# goes through this wrapper so call sites stay version-agnostic.
+if hasattr(jax, "shard_map"):  # pragma: no cover - one branch per jax
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        """Version-portable ``jax.shard_map``."""
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:  # pragma: no cover - one branch per jax
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        """Version-portable ``jax.shard_map`` (pre-0.4.38 spelling)."""
+        return _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs, check_rep=check_vma)
+
+
+def _auto_mesh(shape: tuple[int, ...], names: tuple[str, ...]) -> Mesh:
+    """``jax.make_mesh`` with Auto axis semantics on every jax version."""
+    if AxisType is None:
+        return jax.make_mesh(shape, names)
+    return jax.make_mesh(shape, names,
+                         axis_types=tuple(AxisType.Auto for _ in names))
 
 
 def dims_create(n: int, ndims: int = 2) -> tuple[int, ...]:
@@ -84,7 +118,7 @@ def make_mesh_1d(n: int | None = None, axis: str = AXIS_Y) -> Mesh:
     """1-D device mesh over ``n`` devices (default: all local devices)."""
     if n is None:
         n = len(jax.devices())
-    return jax.make_mesh((n,), (axis,), axis_types=(_AUTO,))
+    return _auto_mesh((n,), (axis,))
 
 
 def make_mesh_2d(py: int | None = None, px: int | None = None) -> Mesh:
@@ -97,4 +131,4 @@ def make_mesh_2d(py: int | None = None, px: int | None = None) -> Mesh:
         py, px = dims_create(len(jax.devices()), 2)
     elif py is None or px is None:
         raise ValueError("pass both py and px, or neither")
-    return jax.make_mesh((py, px), (AXIS_Y, AXIS_X), axis_types=(_AUTO, _AUTO))
+    return _auto_mesh((py, px), (AXIS_Y, AXIS_X))

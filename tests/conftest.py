@@ -38,6 +38,16 @@ def random_board(rng, ny, nx, density=0.35):
     return (rng.random((ny, nx)) < density).astype(np.uint8)
 
 
+def multiprocess_cpu_supported() -> bool:
+    """Whether the installed jaxlib can compile cross-process SPMD on the
+    CPU backend. The 0.4.x line cannot ("Multiprocess computations aren't
+    implemented on the CPU backend" at compile time); the real
+    ``jax.distributed`` two-process tests need >= 0.5."""
+    import jaxlib
+
+    return tuple(int(x) for x in jaxlib.__version__.split(".")[:2]) >= (0, 5)
+
+
 @pytest.fixture
 def make_board(rng):
     def _make(ny, nx, density=0.35):

@@ -642,7 +642,8 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
     # ...but the dispatch plan expands budget-fitting K/V to reach the
     # kernel (chip-measured ~2.7x over the folded jnp path), and the
     # provenance stamp says so.
-    assert context._flash_dispatch_plan(*qkv(hkv=2)) == ("expand", 1024, 2)
+    assert context._flash_dispatch_plan(*qkv(hkv=2)) == (
+        "expand", 1024, 1024, 2)
     assert context.flash_engine_for(*qkv(hkv=2)) == "pallas:b1024:kvx2"
     # Over the expand budget (2 GiB combined K+V) GQA stays on the
     # folded jnp engine. Shape probes only — nothing this size is
@@ -664,10 +665,13 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
     assert context._flash_block_for(32768) == 1024
     assert context._flash_block_for(16384) == 1024  # grid floor exactly met
     assert context._flash_block_for(8192) == 512  # b1024 would leave 8x8
-    # Below 8k no >= _FLOOR_MIN_EDGE block can form a _MIN_GRID grid:
-    # fall back to the plain largest-dividing choice rather than
-    # extrapolate the 8k finding to unmeasured 128/256 grids.
-    assert context._flash_block_for(4096) == 1024
+    # The floor applies at EVERY edge now (the 8k starvation finding
+    # extrapolates: a starved grid is a grid property, not a b1024
+    # property), so 2k-4k step down to the occupancy-floored edge.
+    assert context._flash_block_for(4096) == 256
+    assert context._flash_block_for(2048) == 128
+    # Sequences too short for ANY edge to form a _MIN_GRID grid take the
+    # largest fitting block rather than drop to jnp.
     assert context._flash_block_for(1536) == 512
     assert context._flash_block_for(1280) == 256
     assert context._flash_block_for(384) == 128
@@ -700,6 +704,29 @@ def test_pallas_dispatch_routing(rng, monkeypatch):
             context._flash_block_override()
     monkeypatch.delenv("MOMP_FLASH_BLOCK")
 
+    # The backward edge is decoupled: its own knob pins the eight
+    # dq/dkv blocks while the forward keeps its auto choice, and the
+    # provenance stamp carries both only when they differ.
+    monkeypatch.setenv("MOMP_FLASH_BLOCK_BWD", "512")
+    assert context._flash_block_for(32768) == 1024
+    assert context._flash_bwd_block_for(32768) == 512
+    assert context._flash_dispatch_plan(*qkv(n=1024)) == (
+        "direct", 1024, 512, 1)
+    assert context.flash_engine_for(*qkv(n=1024)) == "pallas:b1024:bw512"
+    # ...and the backward edge tightens divisibility on its own axis.
+    assert not context._pallas_flash_eligible(*qkv(n=1280))  # % 512
+    for bad in ("64", "100"):
+        monkeypatch.setenv("MOMP_FLASH_BLOCK_BWD", bad)
+        with pytest.raises(ValueError, match="MOMP_FLASH_BLOCK_BWD"):
+            context._flash_block_override_bwd()
+    monkeypatch.delenv("MOMP_FLASH_BLOCK_BWD")
+    # The gate's module-internal backward force mirrors the env knob;
+    # unpinned, the backward follows the forward choice exactly.
+    monkeypatch.setattr(context, "_FORCED_BLOCK_BWD", 256)
+    assert context._flash_bwd_block_for(32768) == 256
+    monkeypatch.setattr(context, "_FORCED_BLOCK_BWD", 0)
+    assert context._flash_bwd_block_for(32768) == 1024
+
     monkeypatch.setattr(context, "_TPU_FLASH", False)
     assert not context._pallas_flash_eligible(*qkv())  # kill switch
 
@@ -727,3 +754,181 @@ def test_ring_attention_default_mesh(rng):
     want = attention_reference(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# The per-hop Pallas ring engine (tentpole): routing, merge math, and
+# end-to-end interpret-mode parity on the virtual mesh.
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """Force the Pallas engine in interpret mode: flip the trace-time
+    module flag and clear jit caches on both sides — the flag is not
+    part of any jit cache key, so stale traces from the other setting
+    must not be reused (in either direction)."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    jax.clear_caches()
+    monkeypatch.setattr(context, "_PALLAS_INTERPRET", True)
+    yield context
+    jax.clear_caches()
+
+
+def test_merge_partials_exact(rng):
+    """The online-softmax combine of two NORMALISED partials over
+    disjoint key sets is the softmax over their union — the identity
+    that lets per-hop flash partials fold in any order. Checked exactly
+    against the one-shot softmax, and for associativity."""
+    from mpi_and_open_mp_tpu.parallel.context import _merge_partials
+
+    h, n, m, d = 2, 16, 24, 8
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+
+    def partial(ks, vs):
+        s = jnp.einsum("hqd,hkd->hqk", q, ks) / np.sqrt(d)
+        L = jax.scipy.special.logsumexp(s, axis=-1)
+        o = jnp.einsum("hqk,hkd->hqd", jnp.exp(s - L[..., None]), vs)
+        return o, L
+
+    o1, L1 = partial(k[:, :10], v[:, :10])
+    o2, L2 = partial(k[:, 10:18], v[:, 10:18])
+    o3, L3 = partial(k[:, 18:], v[:, 18:])
+    want_o, want_L = partial(k, v)
+
+    o12, L12 = _merge_partials(o1, L1, o2, L2)
+    got_o, got_L = _merge_partials(o12, L12, o3, L3)
+    np.testing.assert_allclose(np.asarray(got_o), np.asarray(want_o),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_L), np.asarray(want_L),
+                               rtol=1e-6, atol=1e-6)
+    # Associative: fold (2,3) first instead.
+    o23, L23 = _merge_partials(o2, L2, o3, L3)
+    alt_o, alt_L = _merge_partials(o1, L1, o23, L23)
+    np.testing.assert_allclose(np.asarray(alt_o), np.asarray(got_o),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(alt_L), np.asarray(got_L),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_ring_hop_engine_routing(monkeypatch):
+    """ring_hop_engine_for: per-hop provenance judged at per-SHARD
+    granularity — the kernel on eligible hop blocks (GQA via the expand
+    form), the jnp fold for causal zigzag / ineligible hop shapes /
+    under the MOMP_RING_HOP kill switch, the local engine at p=1."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    def qkv(h=4, hkv=4, n=8192, d=128):
+        q = jnp.zeros((h, n, d), jnp.bfloat16)
+        k = jnp.zeros((hkv, n, d), jnp.bfloat16)
+        return q, k, jnp.zeros((hkv, n, d), jnp.bfloat16)
+
+    # On the real (cpu) test backend hops are jnp — same predicate as
+    # the local dispatch, applied to the hop block shape.
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "jnp"
+
+    monkeypatch.setattr(context.jax, "default_backend", lambda: "tpu")
+    # 8k global over 8 devices -> 1k hop blocks.
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    # GQA hops expand locally per hop; the stamp says so.
+    assert (context.ring_hop_engine_for(*qkv(hkv=2), p=8)
+            == "pallas:b1024:kvx2")
+    # Causal zigzag's quarter-block masks aren't expressible with the
+    # kernel's static causal flag: stays on the jnp fold. Non-causal
+    # zigzag has no masks, so it may take the kernel.
+    assert context.ring_hop_engine_for(
+        *qkv(), p=8, causal=True, layout="zigzag") == "jnp"
+    assert context.ring_hop_engine_for(
+        *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b1024"
+    # Hop blocks that fail the kernel predicate (seq % 128) fall back.
+    assert context.ring_hop_engine_for(*qkv(n=8 * 1000), p=8) == "jnp"
+    # A 1-device ring never enters the ring body: local provenance.
+    assert (context.ring_hop_engine_for(*qkv(), p=1)
+            == "local:pallas:b512")
+    # Kill switch pins the ring to the jnp fold oracle.
+    monkeypatch.setattr(context, "_RING_HOP", False)
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "jnp"
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hkv", [4, 2])
+def test_ring_hop_flash_interpret_parity(rng, sp_mesh, pallas_interpret,
+                                         causal, hkv):
+    """End-to-end ring attention with the per-hop Pallas engine engaged
+    (interpret mode, 8-virtual-device mesh): forward AND grads must
+    match both the dense oracle and the jnp fold it replaced. hkv=2
+    exercises the per-hop GQA expand and the folded-L handoff to the
+    travelling-dk/dv backward."""
+    context = pallas_interpret
+    h, n, d = 4, 8 * 128, 128  # 128-per-shard hops: interpret-eligible
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    sp_mesh_p = sp_mesh.shape["sp"]
+
+    stamp = context.ring_hop_engine_for(q, k, v, p=sp_mesh_p, causal=causal)
+    assert stamp == ("pallas:b128" if hkv == h else "pallas:b128:kvx2")
+
+    kr = jnp.repeat(k, h // hkv, axis=0)
+    vr = jnp.repeat(v, h // hkv, axis=0)
+
+    got = ring_attention(q, k, v, mesh=sp_mesh, causal=causal)
+    want = attention_reference(q, kr, vr, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+    # Against the jnp fold oracle it replaced (kill switch flips the
+    # trace-time routing; caches cleared so the flip is honoured).
+    try:
+        context._RING_HOP = False
+        jax.clear_caches()
+        fold = ring_attention(q, k, v, mesh=sp_mesh, causal=causal)
+    finally:
+        context._RING_HOP = True
+        jax.clear_caches()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(fold),
+                               rtol=1e-4, atol=1e-4)
+
+    # Grads: the hop engine feeds its merged (o, L) into the same
+    # travelling-dk/dv ring backward (the kernel's own vjp is never
+    # entered — it is broken under 0.4.37 interpret, so passing proves
+    # the custom_vjp contract held).
+    def loss(fn, q_, k_, v_):
+        return jnp.sum(fn(q_, k_, v_) ** 2)
+
+    g_got = jax.grad(loss, argnums=(1, 2, 3))(
+        lambda a, b, c: ring_attention(a, b, c, mesh=sp_mesh,
+                                       causal=causal), q, k, v)
+    g_want = jax.grad(loss, argnums=(1, 2, 3))(
+        lambda a, b, c: attention_reference(
+            a, jnp.repeat(b, h // hkv, axis=0),
+            jnp.repeat(c, h // hkv, axis=0), causal=causal), q, k, v)
+    for got_g, want_g in zip(g_got, g_want):
+        assert got_g.shape == want_g.shape
+        np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_pallas_flash_interpret_shard_map_single_device(rng,
+                                                        pallas_interpret):
+    """A 1-device sp mesh with the Pallas dispatch force-engaged
+    (interpret mode): shard_map + _pallas_flash must compile together
+    and match the dense oracle — the minimal on-chip local dispatch,
+    runnable without hardware. Forward only: 0.4.37's interpret
+    discharge rule breaks in the kernel backward, which is exactly why
+    the ring keeps its own custom_vjp."""
+    context = pallas_interpret
+    h, n, d = 2, 1024, 128  # n > _Q_CHUNK so the dense short-circuit
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+
+    # Interpret mode skips the backend check but still wants blk == seq.
+    assert context.flash_engine_for(q, k, v) == "pallas:b1024"
+    mesh1 = mesh_lib.make_mesh_1d(1, axis="sp")
+    got = ring_attention(q, k, v, mesh=mesh1, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)

@@ -12,6 +12,14 @@ sweeps are kept minimal.
 import os
 import subprocess
 
+import pytest
+
+import conftest
+
+
+_needs_mp_cpu = pytest.mark.skipif(
+    not conftest.multiprocess_cpu_supported(),
+    reason="installed jaxlib's CPU backend cannot compile multi-process SPMD")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -25,6 +33,7 @@ def _run(script, *args, timeout=240):
     )
 
 
+@_needs_mp_cpu
 def test_job_life_two_process_sweep(tmp_path):
     """np=1..2 Life sweep: each np appends exactly ONE wall-seconds line
     (rank-0-only output discipline), consumable by analysis/plot_life.py."""
@@ -38,6 +47,7 @@ def test_job_life_two_process_sweep(tmp_path):
     assert all(float(x) > 0 for x in lines)
 
 
+@_needs_mp_cpu
 def test_job_pingpong_mult_placement(tmp_path):
     """The 2-process fabric probe (the reference's job_mult.sh placement)
     writes the reference CSV schema from rank 0."""
@@ -52,6 +62,7 @@ def test_job_pingpong_mult_placement(tmp_path):
     assert all(float(line.split(",")[1]) > 0 for line in rows[1:])
 
 
+@_needs_mp_cpu
 def test_job_integral_two_process(tmp_path):
     times = tmp_path / "times_int.txt"
     r = _run("job_integral.sh", "--n=1000000", "--max-procs=2",
@@ -62,6 +73,7 @@ def test_job_integral_two_process(tmp_path):
     assert all(float(x) >= 0 for x in lines)
 
 
+@_needs_mp_cpu
 def test_job_attention_zigzag_grad(tmp_path):
     """The long-context job launcher: 2 real processes running the
     striped/zigzag causal ring with GQA and the flash backward; the
