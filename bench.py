@@ -29,38 +29,78 @@ STEPS = 10_000
 
 
 def _probe_devices(timeout_s: float) -> tuple[bool, str]:
-    """Can a subprocess finish jax device discovery in time?
+    """One device-discovery probe. The implementation lives in
+    ``robust.watchdog.probe_once`` (subprocess probe; a hung child is
+    ABANDONED, never killed — a killed mid-claim client wedges the relay
+    for hours, see .claude/skills/verify/SKILL.md). This module-level
+    indirection stays: tests stub it, and ``probe_devices`` below is
+    handed the attribute at call time so the stub keeps working."""
+    from mpi_and_open_mp_tpu.robust import watchdog
 
-    On timeout the child is ABANDONED, never killed: a killed
-    mid-claim client is what wedges the relay for hours (see
-    .claude/skills/verify/SKILL.md) — and a kill here would land right
-    before the measurement the probe exists to protect. The orphan
-    either completes harmlessly (device freed on exit) or fails out on
-    the relay's own clock.
+    return watchdog.probe_once(timeout_s)
+
+
+def _env_num(name: str, default, cast):
+    import os
+
+    try:
+        return cast(os.environ.get(name, default))
+    except ValueError:
+        return cast(default)
+
+
+def _checkpointed_run(args) -> dict:
+    """The robustness phase: a checkpointed (optionally resumed) serial
+    Life run of the bench workload, CRC-stamped and — when the board is
+    small enough to replay on the host — parity-gated against the
+    fault-free NumPy oracle. This is what the chaos CI smoke drives:
+    under ``MOMP_CHAOS=preempt=k`` the run raises
+    :class:`~mpi_and_open_mp_tpu.robust.preempt.Preempted` after flushing
+    a checkpoint (main() turns that into exit 75 + ``"resume": true``),
+    and the follow-up ``--resume`` invocation must complete bit-identical
+    to the oracle.
     """
-    import subprocess
-    import tempfile
+    import zlib
 
-    with tempfile.TemporaryFile() as err:
-        child = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL, stderr=err,
-        )
-        def tail() -> str:
-            err.seek(0)
-            text = err.read().decode(errors="replace").strip()
-            return f": ...{text[-160:]}" if text else ""
+    from mpi_and_open_mp_tpu.apps.life import find_latest_checkpoint
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.utils.config import config_from_board
 
-        try:
-            rc = child.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            # Snapshot whatever stderr the child produced so far — the
-            # relay error in it is what an operator needs to diagnose.
-            return False, ("TimeoutExpired: discovery hung; probe "
-                           "abandoned un-killed" + tail())
-        if rc == 0:
-            return True, ""
-        return False, f"probe exit {rc}" + tail()
+    rng = np.random.default_rng(46)  # same board as the headline phases
+    board = (rng.random((NY, NX)) < 0.3).astype(np.uint8)
+    cfg = config_from_board(board, steps=STEPS, save_steps=0)
+    every = args.checkpoint_every or max(1, STEPS // 10)
+    kwargs = dict(layout="serial", impl="auto",
+                  checkpoint_dir=args.checkpoint_dir,
+                  checkpoint_every=every)
+    fields = {"checkpoint_every": every}
+    if args.resume:
+        latest = find_latest_checkpoint(args.checkpoint_dir)
+        if latest is None:
+            raise RuntimeError(
+                f"--resume: no checkpoints in {args.checkpoint_dir!r}")
+        path, step = latest
+        sim = LifeSim.from_checkpoint(path, cfg, **kwargs)
+        fields["resumed_step"] = step
+    else:
+        sim = LifeSim(cfg, **kwargs)
+    final = sim.run()  # raises Preempted on signal / chaos preemption
+    crc = zlib.crc32(np.ascontiguousarray(final).tobytes()) & 0xFFFFFFFF
+    fields["checkpoint_run_crc32"] = f"{crc:08x}"
+    if sim.recoveries:
+        fields["checkpoint_run_recovered"] = list(sim.recoveries)
+    # Host oracle replay is O(NY*NX*STEPS) python-side — gate it to the
+    # smoke sizes; the flagship keeps only the CRC (cross-run comparable).
+    if NY * NX * STEPS <= 2**26:
+        oracle = board.copy()
+        for _ in range(STEPS):
+            oracle = life_step_numpy(oracle)
+        if not np.array_equal(final, oracle):
+            raise RuntimeError(
+                "checkpointed run diverged from the fault-free oracle")
+        fields["checkpoint_parity"] = True
+    return fields
 
 
 def main(argv=None) -> int:
@@ -69,31 +109,75 @@ def main(argv=None) -> int:
                     help="override board edge (e.g. 8192 for the big-grid "
                     "strong-scaling config); default 500 (p46gun_big)")
     ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="run the checkpointed robustness phase, writing "
+                    "Orbax restart points here")
+    ap.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
+                    help="checkpoint cadence for that phase "
+                    "(default: steps//10)")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue the checkpointed phase from the latest "
+                    "restart point in --checkpoint-dir")
     args = ap.parse_args(argv)
+    if args.resume and not args.checkpoint_dir:
+        ap.error("--resume requires --checkpoint-dir")
     global NY, NX, STEPS
     if args.board:
         NY = NX = args.board
     if args.steps:
         STEPS = args.steps
 
-    # Backend watchdog: a wedged axon relay (observed after a TPU client
-    # was killed mid-claim) makes jax.devices() hang indefinitely IN THIS
-    # PROCESS too — probe device discovery in a subprocess first and fall
-    # back to CPU (honestly labelled) so the bench records a line instead
-    # of hanging the harness.
-    import os
-    backend_note = {}
+    # Driver contract: ONE JSON line, always — a failure anywhere prints
+    # {"metric", "error", "phase"} and exits nonzero instead of dying on
+    # a traceback with no line. A preemption (signal or chaos plan) is
+    # the one non-error failure: state is flushed, the line says
+    # "resume": true, and the exit code is 75 (EX_TEMPFAIL) so queue
+    # loops requeue instead of dropping the job.
+    state = {"phase": "probe"}
     try:
-        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
-    except ValueError:
-        probe_timeout = 240.0
-    ok, why = _probe_devices(probe_timeout)
-    if not ok:
+        return _bench(args, state)
+    except BaseException as e:  # noqa: BLE001 — the line IS the contract
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        from mpi_and_open_mp_tpu.robust.preempt import (
+            EXIT_PREEMPTED, Preempted)
+
+        rec = {"metric": "life_steady_cups_p46gun_big",
+               "error": f"{type(e).__name__}: {e}"[:300],
+               "phase": state["phase"]}
+        if isinstance(e, Preempted):
+            rec["resume"] = True
+            print(json.dumps(rec))
+            return EXIT_PREEMPTED
+        print(json.dumps(rec))
+        return 1
+
+
+def _bench(args, state) -> int:
+    # Backend watchdog (robust.watchdog): a wedged axon relay (observed
+    # after a TPU client was killed mid-claim) makes jax.devices() hang
+    # indefinitely IN THIS PROCESS too — probe device discovery in a
+    # subprocess first, with bounded exponential backoff when
+    # BENCH_PROBE_ATTEMPTS asks for retries, and fall back to CPU
+    # (honestly labelled) so the bench records a line instead of hanging
+    # the harness.
+    from mpi_and_open_mp_tpu.robust import guards, watchdog
+
+    backend_note = {}
+    res = watchdog.probe_devices(
+        _env_num("BENCH_PROBE_TIMEOUT_S", 240, float),
+        attempts=_env_num("BENCH_PROBE_ATTEMPTS", 1, int),
+        backoff_s=_env_num("BENCH_PROBE_BACKOFF_S", 2.0, float),
+        probe=_probe_devices,  # the module attribute — tests stub it
+    )
+    if not res.ok:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        note = res.why + (f" after {res.attempts} attempts"
+                          if res.attempts > 1 else "")
         backend_note = {"backend_fallback": (
-            f"device discovery failed/hung ({why}); "
+            f"device discovery failed/hung ({note}); "
             "ran on CPU — not a TPU measurement"
         ), "chip_record": (
             "results/bench_tpu_r05.jsonl holds committed real-chip "
@@ -109,6 +193,7 @@ def main(argv=None) -> int:
     board = (rng.random((NY, NX)) < 0.3).astype(np.uint8)
 
     # Honesty gate: the timed impl must be bit-exact vs the host oracle.
+    state["phase"] = "parity"
     cfg_check = config_from_board(board, steps=8, save_steps=0)
     sim_check = LifeSim(cfg_check, layout="serial", impl="auto")
     got = sim_check.run(save=False)
@@ -119,8 +204,18 @@ def main(argv=None) -> int:
         print(json.dumps({"metric": "life_steady_cups_p46gun_big",
                           "value": 0.0,
                           "unit": "cell_updates_per_sec", "vs_baseline": 0.0,
-                          "error": "parity check failed"}))
+                          "error": "parity check failed",
+                          "phase": "parity"}))
         return 1
+
+    # Robustness phase (opt-in via --checkpoint-dir): checkpointed run
+    # with resume/preemption semantics; its fields ride the bench line.
+    ckpt_fields = {}
+    if args.checkpoint_dir:
+        state["phase"] = "checkpoint"
+        ckpt_fields = _checkpointed_run(args)
+
+    state["phase"] = "measure"
 
     def measure(sim):
         """(best_sec, steady_sec, differenced) for STEPS steps.
@@ -184,6 +279,7 @@ def main(argv=None) -> int:
     # would grind on CPU).
     sharded = {}
     if jax.default_backend() == "tpu":
+        state["phase"] = "sharded"
         from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
 
         sim_sh = LifeSim(cfg, layout="row", impl="bitfused",
@@ -215,6 +311,7 @@ def main(argv=None) -> int:
         # chaining R calls in one dispatch (output feeds the next call's
         # queries, so the chain can't be elided) and differencing —
         # the same RTT-cancelling discipline as the Life numbers.
+        state["phase"] = "attention"
         import jax.numpy as jnp
         from jax import lax as jlax
 
@@ -334,6 +431,11 @@ def main(argv=None) -> int:
                     3.5 * flops / grad_sec / 1e12, 1),
                 "attention_grad_is_differenced": grad_diff,
             })
+    state["phase"] = "report"
+    # Self-healed dispatches (robust.guards) must surface in the
+    # artifact: a silently recovered engine would launder a fault into a
+    # clean-looking measurement line.
+    recovered = guards.recovery_log()
     print(json.dumps({
         "metric": "life_steady_cups_p46gun_big",
         "value": round(steady_cups, 1),
@@ -348,6 +450,11 @@ def main(argv=None) -> int:
         "steady_is_differenced": differenced,
         "backend": jax.default_backend(),
         "impl": sim.impl,
+        # True whenever the watchdog degraded the run to CPU — the
+        # machine-readable twin of backend_fallback.
+        "degraded": res.degraded,
+        **({"recovered": recovered} if recovered else {}),
+        **ckpt_fields,
         **sharded,
         **backend_note,
     }))

@@ -54,7 +54,16 @@ while true; do
                 mkdir -p "$QUEUE/done" && mv "$job" "$QUEUE/done/"
                 log "done $job"
             else
-                log "FAILED $job (kept queued); re-probing"
+                rc=$?
+                if [ "$rc" -eq 75 ]; then
+                    # EX_TEMPFAIL: the job preempted itself after
+                    # flushing a checkpoint (robust.preempt contract) —
+                    # keep it queued; its own --resume continues the
+                    # work on the next drain pass.
+                    log "PREEMPTED $job (rc 75; checkpoint flushed; kept queued for --resume)"
+                else
+                    log "FAILED $job (rc $rc, kept queued); re-probing"
+                fi
                 drained=0
                 break
             fi

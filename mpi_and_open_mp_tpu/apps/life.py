@@ -25,6 +25,7 @@ from mpi_and_open_mp_tpu.apps._common import (
     add_platform_args, apply_platform_args, is_primary)
 from mpi_and_open_mp_tpu.models.life import IMPLS, LAYOUTS, LifeSim
 from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+from mpi_and_open_mp_tpu.robust.preempt import EXIT_PREEMPTED, Preempted
 from mpi_and_open_mp_tpu.utils.config import load_config
 from mpi_and_open_mp_tpu.utils.timing import append_times_txt
 
@@ -54,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="write an Orbax checkpoint at every save point "
                         "(sharded; no gather-to-root on multi-host)")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="also checkpoint every N steps, independent of the "
+                        "save cadence (preemption-safe restart points; "
+                        "SIGTERM flushes one and exits 75)")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the run into DIR")
     p.add_argument("--debug-check", action="store_true",
@@ -115,6 +120,7 @@ def main(argv=None) -> int:
         fuse_steps=args.fuse_steps,
         outdir=args.outdir,
         checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
     )
     if args.resume:
         # Resume from whichever persisted state is newest (a stale
@@ -155,7 +161,13 @@ def main(argv=None) -> int:
         ctx = contextlib.nullcontext()
     with ctx:
         t0 = time.perf_counter()
-        final = sim.run()  # collect() inside forces device completion
+        try:
+            final = sim.run()  # collect() inside forces device completion
+        except Preempted as e:
+            # EX_TEMPFAIL: the queue keeps the job; --resume continues
+            # from the flushed checkpoint (docs/MIGRATION.md workflow).
+            print(f"{e} -- requeue with --resume", file=sys.stderr)
+            return EXIT_PREEMPTED
         elapsed = time.perf_counter() - t0
     if args.debug_check:
         sim.debug_check()

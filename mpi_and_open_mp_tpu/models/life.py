@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Callable
 
 import numpy as np
@@ -144,6 +145,7 @@ class LifeSim:
         dtype=jnp.uint8,
         outdir: str | os.PathLike | None = None,
         checkpoint_dir: str | os.PathLike | None = None,
+        checkpoint_every: int = 0,
         initial_board: np.ndarray | None = None,
         initial_step: int = 0,
     ):
@@ -160,6 +162,12 @@ class LifeSim:
         self.checkpoint_dir = (
             os.fspath(checkpoint_dir) if checkpoint_dir is not None else None
         )
+        # Periodic restart cadence (steps between Orbax checkpoints inside
+        # run(); 0 = only the save_steps cadence writes checkpoints) and
+        # the per-run recovery provenance the guards append to.
+        self.checkpoint_every = max(0, int(checkpoint_every))
+        self.recoveries: list[str] = []
+        self._probe = None  # lazy (board, oracle) pair for _probe_case
         self.step_count = int(initial_step)
 
         divisible = _divisible(cfg.shape, layout, self.mesh)
@@ -513,20 +521,90 @@ class LifeSim:
         board = vtk_lib.read_vtk(snapshot_path)
         return cls(cfg, initial_board=board, initial_step=step, **kwargs)
 
-    def _segment_lengths(self) -> list[int]:
-        """Distinct ``advance`` step counts a full ``run()`` will request."""
+    def _next_stop(self, i: int, save: bool) -> int:
+        """First step index after ``i`` where run() must pause the advance:
+        the end of the budget, a snapshot/checkpoint boundary, or a pending
+        simulated-preemption point (segments never straddle the preempt
+        step — the flush must happen exactly there)."""
+        from mpi_and_open_mp_tpu.robust import chaos
+
         cfg = self.cfg
+        stops = [cfg.steps]
+        if save and cfg.save_steps > 0:
+            stops.append((i // cfg.save_steps + 1) * cfg.save_steps)
+        ck = self.checkpoint_every
+        if self.checkpoint_dir is not None and ck > 0:
+            stops.append((i // ck + 1) * ck)
+        plan = chaos.active_plan()
+        if plan is not None and plan.preempt_pending(i):
+            stops.append(plan.preempt_step)
+        return min(s for s in stops if s > i)
+
+    def _segment_lengths(self, save: bool = True) -> list[int]:
+        """Distinct ``advance`` step counts a full ``run()`` will request."""
         i = self.step_count
-        if i >= cfg.steps:
-            return []
-        if cfg.save_steps <= 0:
-            return [cfg.steps - i]
         lengths = set()
-        while i < cfg.steps:
-            next_stop = min(cfg.steps, (i // cfg.save_steps + 1) * cfg.save_steps)
+        while i < self.cfg.steps:
+            next_stop = self._next_stop(i, save)
             lengths.add(next_stop - i)
             i = next_stop
         return sorted(lengths)
+
+    def _consistency_violation(self) -> str | None:
+        """The semantic halo-consistency probe, as a description or None.
+
+        Life's stencil output is ALWAYS binary, so a value invariant alone
+        can never catch a corrupted halo row after a step — the meaningful
+        check is (a) the cheap binary-domain scan plus (b) a single-step
+        parity probe: one step of the configured pipeline from the current
+        collected board must equal one oracle (NumPy) step. Under an active
+        fault plan the n=1 probe program traces through the same injection
+        hooks as the segment program (faults are sticky at trace time), so
+        a poisoned exchange cannot hide from the probe.
+        """
+        before = self.collect()
+        if not np.isin(before, (0, 1)).all():
+            return "non-binary cells on the board"
+        after_impl = np.asarray(
+            jax.device_get(self._advance(self.board, 1)), dtype=np.uint8
+        )[: self.cfg.ny, : self.cfg.nx]
+        expect = life_ops.life_step_numpy(before)
+        if not np.array_equal(after_impl, expect):
+            diff = int((after_impl != expect).sum())
+            return (
+                f"{diff} cells diverge from the oracle after one "
+                f"{self.impl}/{self.layout} step"
+            )
+        # The live-board probe alone can be blind: a corrupted exchange
+        # whose effect on THIS board's next step happens to be nil leaves
+        # earlier accumulated divergence undetected. The same n=1 program
+        # on a fixed dense random board is board-state-independent — a
+        # poisoned ghost row over a random edge perturbs neighbour counts
+        # with near-certainty.
+        probe, probe_expect = self._probe_case()
+        after_probe = np.asarray(
+            jax.device_get(self._advance(probe, 1)), dtype=np.uint8
+        )[: self.cfg.ny, : self.cfg.nx]
+        if not np.array_equal(after_probe, probe_expect):
+            diff = int((after_probe != probe_expect).sum())
+            return (
+                f"{diff} cells diverge from the oracle after one "
+                f"{self.impl}/{self.layout} step on the fixed probe board"
+            )
+        return None
+
+    def _probe_case(self):
+        """Cached ``(device_board, oracle_next)`` for the fixed-probe leg of
+        ``_consistency_violation`` — placed exactly like the live board."""
+        if self._probe is None:
+            host = np.random.default_rng(0xC0FFEE).integers(
+                0, 2, (self.cfg.ny, self.cfg.nx), dtype=np.uint8)
+            full = np.zeros(self.padded_shape, dtype=np.uint8)
+            full[: self.cfg.ny, : self.cfg.nx] = host
+            b = jnp.asarray(full, dtype=self.dtype)
+            b = jax.device_put(b, self.sharding) if self.sharding else b
+            self._probe = (b, life_ops.life_step_numpy(host))
+        return self._probe
 
     def debug_check(self) -> None:
         """Debug mode: assert halo-exchange consistency on the live state.
@@ -539,17 +617,66 @@ class LifeSim:
         gathered global board. Raises AssertionError with a cell-diff count
         on mismatch.
         """
-        before = self.collect()
-        after_impl = np.asarray(
-            jax.device_get(self._advance(self.board, 1)), dtype=np.uint8
-        )[: self.cfg.ny, : self.cfg.nx]
-        expect = life_ops.life_step_numpy(before)
-        if not np.array_equal(after_impl, expect):
-            diff = int((after_impl != expect).sum())
-            raise AssertionError(
-                f"halo debug check failed: {diff} cells diverge from the "
-                f"oracle after one {self.impl}/{self.layout} step"
-            )
+        why = self._consistency_violation()
+        if why is not None:
+            raise AssertionError(f"halo debug check failed: {why}")
+
+    def _set_board(self, board: np.ndarray, step: int) -> None:
+        """Install a host board as the live state (pad + device_put), the
+        same placement the constructor performs."""
+        board = np.asarray(board, dtype=np.uint8)
+        if self.padded_shape != board.shape:
+            full = np.zeros(self.padded_shape, dtype=np.uint8)
+            full[: self.cfg.ny, : self.cfg.nx] = board
+            board = full
+        b = jnp.asarray(board, dtype=self.dtype)
+        self.board = jax.device_put(b, self.sharding) if self.sharding else b
+        self.step_count = int(step)
+
+    def _checkpoint_now(self) -> str:
+        path = os.path.join(
+            self.checkpoint_dir, f"step_{self.step_count:06d}")
+        self.save_checkpoint(path)
+        return path
+
+    def _guarded_step(self, n: int) -> None:
+        """``step(n)`` with the halo-exchange checksum guard armed.
+
+        On a consistency violation: rebuild the compiled steppers with
+        injection suppressed (the poisoned traces are cached on the old
+        wrappers — a transient fault must not re-fire on the dispatch that
+        retries it), restore the pre-segment board and re-step; if even the
+        clean re-trace diverges, replay the segment on the NumPy oracle as
+        the last resort. Every recovery stamps ``self.recoveries`` and the
+        process-wide log ``bench.py`` publishes.
+        """
+        from mpi_and_open_mp_tpu.robust import chaos, guards
+
+        prev_board = self.board
+        prev_step = self.step_count
+        self.step(n)
+        why = self._consistency_violation()
+        if why is None:
+            return
+        with chaos.suppressed():
+            self._advance = self._build_advance()
+            self.board = prev_board
+            self.step_count = prev_step
+            self.step(n)
+            still = self._consistency_violation()
+        if still is None:
+            stamp = f"life_step:{self.impl}:recovered"
+            self.recoveries.append(f"{stamp} ({why})")
+            guards.record_recovery(stamp)
+            return
+        board = np.asarray(jax.device_get(prev_board), dtype=np.uint8)[
+            : self.cfg.ny, : self.cfg.nx]
+        for _ in range(n):
+            board = life_ops.life_step_numpy(board)
+        self._set_board(board, prev_step + n)
+        stamp = "life_step:numpy-oracle:recovered"
+        self.recoveries.append(f"{stamp} ({why}; then {still})")
+        guards.record_recovery(stamp)
 
     def warmup(self) -> None:
         """Compile every stepper a subsequent ``run()`` will hit.
@@ -616,22 +743,64 @@ class LifeSim:
         Snapshots are written at every step index ``i < steps`` with
         ``i % save_steps == 0`` (before stepping), matching
         ``3-life/life_mpi.c:51-58``. Returns the final board.
+
+        Robustness (all inert on the default path): periodic Orbax
+        checkpoints every ``checkpoint_every`` steps; SIGTERM/SIGINT flush
+        a final checkpoint at the next segment boundary and raise
+        :class:`~mpi_and_open_mp_tpu.robust.preempt.Preempted`; an active
+        ``MOMP_CHAOS`` plan can inject halo faults (caught by the guarded
+        step) or fire a simulated preemption at a fixed step; guards are
+        armed by the plan or ``MOMP_GUARD=1``.
         """
+        from mpi_and_open_mp_tpu.robust import chaos, guards, preempt
+
         cfg = self.cfg
         if save is None:
             save = self.outdir is not None or self.checkpoint_dir is not None
         # save_steps <= 0 means "never save" (the reference's 999999 idiom,
         # p46gun_big.cfg, taken to its limit); so does save=False.
-        if not save or cfg.save_steps <= 0:
+        save = save and cfg.save_steps > 0
+        plan = chaos.active_plan()
+        guard = guards.guards_active()
+        checkpointing = (
+            self.checkpoint_dir is not None and self.checkpoint_every > 0
+        )
+        if not save and not checkpointing and plan is None and not guard:
+            # The default fast path, unchanged: one advance covers the
+            # whole budget, no host round trips inside it.
             if cfg.steps > self.step_count:
                 self.step(cfg.steps - self.step_count)
             return self.collect()
         i = self.step_count
-        while i < cfg.steps:
-            if i % cfg.save_steps == 0:
-                self.save_state()
-            # Advance to the next save point (or the end) in one jit call.
-            next_stop = min(cfg.steps, (i // cfg.save_steps + 1) * cfg.save_steps)
-            self.step(next_stop - i)
-            i = next_stop
+        with preempt.flush_on_signal(
+                enabled=self.checkpoint_dir is not None) as sig:
+            while i < cfg.steps:
+                if sig.fired is not None:
+                    # A real SIGTERM/SIGINT landed mid-run: flush a
+                    # restart point at this segment boundary and hand the
+                    # driver the exit-75 contract (preempt module docs).
+                    path = (self._checkpoint_now()
+                            if self.checkpoint_dir is not None else None)
+                    raise preempt.Preempted(
+                        i, checkpoint=path, signum=sig.fired)
+                if save and i % cfg.save_steps == 0:
+                    self.save_state()
+                elif checkpointing and i > 0 and i % self.checkpoint_every == 0:
+                    self._checkpoint_now()
+                if plan is not None and plan.delay_s:
+                    time.sleep(plan.delay_s)
+                # Advance to the next boundary in one jit call.
+                next_stop = self._next_stop(i, save)
+                if guard:
+                    self._guarded_step(next_stop - i)
+                else:
+                    self.step(next_stop - i)
+                prev_i, i = i, next_stop
+                if (plan is not None and plan.preempt_step is not None
+                        and not plan.preempt_fired
+                        and prev_i < plan.preempt_step <= i):
+                    plan.preempt_fired = True
+                    path = (self._checkpoint_now()
+                            if self.checkpoint_dir is not None else None)
+                    raise preempt.SimulatedPreemption(i, checkpoint=path)
         return self.collect()

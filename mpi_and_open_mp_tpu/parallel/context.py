@@ -383,6 +383,18 @@ def _ring_forward(axis: str, causal: bool, layout: str, q, k, v):
 
         state0 = (s_lo0, s_hi0)
 
+    # Chaos hook (robust.chaos): a planned nan_hop/inf_hop poisons the
+    # K/V partials of exactly that hop, baked in at trace time. The jnp
+    # fold carries the same injection point as the per-hop Pallas engine
+    # so an UNgated fold provably diverges under injection; the guarded
+    # recovery path re-traces under chaos.suppressed() and stays clean.
+    # When MOMP_CHAOS is unset no ops are added (trace-time `is None`).
+    from mpi_and_open_mp_tpu.robust import chaos as _chaos
+
+    _poison = _chaos.hop_poison_spec()
+    if _poison is not None:
+        fold = _chaos.poisoned_fold(fold, _poison)
+
     def hop(j, carry):
         state, kb, vb = carry
         # Double-buffered rotation: issue the NEXT hop's K/V transfer
@@ -861,14 +873,26 @@ def gated_parity_check(heads: int = 8, n: int = 2048, dim: int = 128,
 
     # Retry keyed on the engine the first attempt actually dispatched to
     # (not the bare flag): off-TPU a jnp failure would otherwise trigger
-    # a pointless cache drop and an identical second jnp run.
+    # a pointless cache drop and an identical second jnp run. The ladder
+    # itself is robust.guards.with_fallback — the same engine-ranked
+    # retry ring_attention's hop guard uses; attempt() keeps appending
+    # its own notes, and disable_tpu_flash flips the global so the
+    # post-fallback tpu_flash_engine() reports the engine that passed.
+    from mpi_and_open_mp_tpu.robust.guards import (
+        FallbackExhausted, with_fallback)
+
     _FORCED_BLOCK = forced
     _FORCED_BLOCK_BWD = forced_bwd
     try:
-        ok = attempt()
-        if not ok and tpu_flash_engine() == "pallas" and not steer_jnp:
-            disable_tpu_flash()
-            ok = attempt()
+        engines = [(tpu_flash_engine(), attempt)]
+        if tpu_flash_engine() == "pallas" and not steer_jnp:
+            engines.append(
+                ("jnp", lambda: (disable_tpu_flash(), attempt())[1]))
+        try:
+            with_fallback(engines, validator=bool)
+            ok = True
+        except FallbackExhausted:
+            ok = False
     finally:
         _FORCED_BLOCK = 0
         _FORCED_BLOCK_BWD = 0
@@ -1050,6 +1074,22 @@ def _plan_stamp(plan) -> str:
 _RING_HOP = os.environ.get("MOMP_RING_HOP", "1") != "0"
 
 
+@contextlib.contextmanager
+def _ring_hop_pinned(value: bool):
+    """Pin the ring-hop engine gate for one dispatch: the guarded
+    recovery path in :func:`ring_attention` re-dispatches a poisoned
+    fold on the jnp fold oracle by tracing with the hop kernel pinned
+    off (paired with a distinct jit-cache key — the flag is read at
+    trace time, not part of the cache key)."""
+    global _RING_HOP
+    prev = _RING_HOP
+    _RING_HOP = value
+    try:
+        yield
+    finally:
+        _RING_HOP = prev
+
+
 def _ring_hop_plan(q, k, v, causal: bool, layout: str):
     """Dispatch plan for the per-hop Pallas ring engine, or ``None``
     (the jnp fold). Operands are the PER-SHARD ``(h, n_local, d)``
@@ -1122,11 +1162,20 @@ def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
     _, blk, _, groups = plan
     perm = ring_perm(p, 1)
 
+    # Chaos hook, mirroring the jnp fold's (see _ring_forward): hop 0 is
+    # the resident diagonal block outside the fold, so it takes the
+    # poison directly; later hops go through the wrapped fold.
+    from mpi_and_open_mp_tpu.robust import chaos as _chaos
+
+    _poison = _chaos.hop_poison_spec()
+    k0, v0 = (_chaos.poison_hop(k, v, 0, _poison)
+              if _poison is not None else (k, v))
+
     # Issue the first rotation before the diagonal block's kernel call
     # (the jnp fold's double-buffering, same latency-hiding pairing).
     k1 = lax.ppermute(k, axis, perm)
     v1 = lax.ppermute(v, axis, perm)
-    state = _hop_flash_block(q, k, v, causal, blk, groups)
+    state = _hop_flash_block(q, k0, v0, causal, blk, groups)
 
     def fold(j, state, kb, vb):
         # After j forward rotations this block originated on ring
@@ -1143,6 +1192,9 @@ def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
             return take(state)
         src = (idx - j) % p
         return lax.cond(src < idx, take, lambda s: s, state)
+
+    if _poison is not None:
+        fold = _chaos.poisoned_fold(fold, _poison)
 
     def hop(j, carry):
         state, kb, vb = carry
@@ -1511,14 +1563,20 @@ def _repeat_heads(k, v, groups: int):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("local_fn", "mesh", "axis", "causal", "layout"),
+    static_argnames=("local_fn", "mesh", "axis", "causal", "layout",
+                     "chaos_key"),
 )
 def _sharded_attention_jit(q, k, v, *, local_fn, mesh: Mesh, axis: str,
-                           causal: bool, **local_kwargs):
+                           causal: bool, chaos_key=None, **local_kwargs):
     """Shared jit + ``shard_map`` scaffold for both attention variants;
     ``local_fn`` is the module-level per-shard body (hashable, so the jit
     cache keys stably on it); extra static kwargs (e.g. the ring
-    ``layout``) pass through."""
+    ``layout``) pass through. ``chaos_key`` is a cache salt only
+    (``robust.chaos``): injection and engine pins are trace-time
+    decisions, so distinct chaos states must never share a trace — it is
+    ``None`` (one cache entry, zero overhead) whenever no plan is
+    active."""
+    del chaos_key
     body = functools.partial(local_fn, axis=axis, causal=causal,
                              **local_kwargs)
     spec = _seq_spec(axis)
@@ -1571,9 +1629,41 @@ def ring_attention(
             f"got seq {q.shape[1]} over {p} devices")
     sharding = NamedSharding(mesh, _seq_spec(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return _sharded_attention_jit(q, k, v, local_fn=_ring_attention_local,
-                                  mesh=mesh, axis=axis, causal=causal,
-                                  layout=layout)
+
+    def dispatch(key=None):
+        return _sharded_attention_jit(
+            q, k, v, local_fn=_ring_attention_local, mesh=mesh, axis=axis,
+            causal=causal, layout=layout, chaos_key=key)
+
+    from mpi_and_open_mp_tpu.robust import chaos, guards
+
+    plan = chaos.active_plan()
+    if plan is None and not guards.guard_env():
+        # The production hot path: one env check, no validator (a finite
+        # check is a full host fetch — see robust.guards module docs).
+        return dispatch()
+    if not guards.guards_active():
+        # Chaos armed with `noguard`: inject, but let the fault land —
+        # the test aid that proves injection reaches the fabric.
+        return dispatch(chaos.trace_key("ring"))
+
+    # NaN/divergence guard on the hop engine: validate the dispatched
+    # fold, and re-dispatch a poisoned one on the jnp fold oracle —
+    # injection suppressed (a transient fault must not re-fire on the
+    # dispatch that retries it), hop kernel pinned off, fresh trace.
+    def primary():
+        return dispatch(chaos.trace_key("ring"))
+
+    def jnp_fold_oracle():
+        with chaos.suppressed(), _ring_hop_pinned(False):
+            return dispatch(("ring", "recover"))
+
+    out, stamp, _notes = guards.with_fallback(
+        [("hop", primary), ("jnp", jnp_fold_oracle)],
+        validator=guards.all_finite)
+    if stamp.endswith(":recovered"):
+        guards.record_recovery(f"ring_attention:{stamp}")
+    return out
 
 
 def flash_attention(

@@ -69,7 +69,17 @@ def ping(mesh: Mesh, msg_bytes: int, reps: int = 100) -> float:
     # Warm-up: compile + first transfer.
     anchor_sync(_ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh),
                 fetch_all=True)
+    # Chaos hook (robust.chaos): an injected host-side delay INSIDE the
+    # timed bracket simulates a congested fabric / slow relay hop, so
+    # harness code consuming these probes (fit sanity, CSV writers) can
+    # be tested against pathological timings. No-op when MOMP_CHAOS is
+    # unset.
+    from mpi_and_open_mp_tpu.robust import chaos
+
+    delay = chaos.dispatch_delay()
     t0 = time.perf_counter()
+    if delay:
+        time.sleep(delay)
     out = _ring_shift_loop(buf, axis=axis, reps=reps, mesh=mesh)
     # Anchored one-element fetch, not bare block_until_ready: the latter
     # is a no-op on some platforms (observed on the axon TPU tunnel);

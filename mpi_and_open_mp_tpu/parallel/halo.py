@@ -45,6 +45,22 @@ def axis_size(axis_name: str) -> int:
 _axis_size = axis_size
 
 
+def _chaos_ghost(ghost: jnp.ndarray) -> jnp.ndarray:
+    """Trace-time chaos hook (``robust.chaos``): with no active
+    ``MOMP_CHAOS`` halo fault the ghost block passes through untouched and
+    no injection ops enter the program — this body runs only while
+    tracing, so the check costs nothing per step. A corrupted/dropped
+    ghost here is what the ``LifeSim`` consistency probe must catch (the
+    packed ``pad > 0`` frame paths funnel through their own slicing and
+    are exercised on the un-padded degenerate route only)."""
+    from mpi_and_open_mp_tpu.robust import chaos
+
+    spec = chaos.halo_ghost_spec()
+    if spec is None:
+        return ghost
+    return chaos.corrupt_ghost(ghost, spec)
+
+
 def halo_pad_y(block: jnp.ndarray, axis_name: str = "y", depth: int = 1) -> jnp.ndarray:
     """Pad axis 0 of a shard with ghost rows from its ring neighbours.
 
@@ -55,7 +71,8 @@ def halo_pad_y(block: jnp.ndarray, axis_name: str = "y", depth: int = 1) -> jnp.
     p = _axis_size(axis_name)
     # My top ghost rows are the *last* rows of my predecessor: everyone
     # sends their bottom edge forward around the ring.
-    top = lax.ppermute(block[-depth:, :], axis_name, ring_perm(p, 1))
+    top = _chaos_ghost(
+        lax.ppermute(block[-depth:, :], axis_name, ring_perm(p, 1)))
     bot = lax.ppermute(block[:depth, :], axis_name, ring_perm(p, -1))
     return jnp.concatenate([top, block, bot], axis=0)
 
@@ -67,7 +84,8 @@ def halo_pad_x(block: jnp.ndarray, axis_name: str = "x", depth: int = 1) -> jnp.
     (``4-life/life_mpi.c:106-109``); here it is a slice + ``ppermute``.
     """
     p = _axis_size(axis_name)
-    left = lax.ppermute(block[:, -depth:], axis_name, ring_perm(p, 1))
+    left = _chaos_ghost(
+        lax.ppermute(block[:, -depth:], axis_name, ring_perm(p, 1)))
     right = lax.ppermute(block[:, :depth], axis_name, ring_perm(p, -1))
     return jnp.concatenate([left, block, right], axis=1)
 

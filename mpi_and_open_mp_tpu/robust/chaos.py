@@ -1,0 +1,223 @@
+"""Deterministic fault injection — the chaos layer of the robust subsystem.
+
+The fabric this framework rides (tunneled single-tenant TPU, preemptible
+hosts, a relay that wedges when clients die mid-claim) fails in ways the
+reference's PBS workflow only ever answered with "rerun the job". This
+module makes those failures *injectable* so every recovery path in the
+stack (``robust.guards``, ``LifeSim`` consistency probes, checkpoint
+resume) is testable on the 8-virtual-device CPU mesh, deterministically,
+without hardware.
+
+Faults are driven entirely by the ``MOMP_CHAOS`` environment variable — a
+semicolon-separated spec::
+
+    MOMP_CHAOS="nan_hop=1;halo=corrupt;delay=0.01;preempt=60;seed=7"
+
+Tokens:
+
+``nan_hop=<j>`` / ``inf_hop=<j>``
+    Poison the K/V partials of ring-attention hop ``j`` with NaN / +inf
+    (``parallel/context.py`` fold engines, jnp and per-hop Pallas alike).
+``halo=corrupt`` / ``halo=drop``
+    Corrupt the ghost rows of every traced halo exchange with seeded
+    out-of-range values, or zero them (the exchange "never arrived") —
+    ``parallel/halo.py``.
+``delay=<seconds>``
+    Host-side artificial dispatch delay per guarded run segment and per
+    fabric ping (``parallel/fabric.py``) — simulates a congested fabric
+    or a slow relay without touching traced code.
+``preempt=<step>``
+    Raise :class:`~mpi_and_open_mp_tpu.robust.preempt.SimulatedPreemption`
+    when a ``LifeSim.run`` crosses global step ``<step>`` (after flushing
+    a checkpoint when one is configured) — the SIGTERM rehearsal.
+``seed=<int>``
+    Seed for corrupted-value generation (default 0).
+``noguard``
+    Inject without arming the guards — the test aid that proves a fault
+    actually lands (the run must then *diverge*).
+
+Injection decisions are made at TRACE time: a poisoned trace stays
+poisoned for every execution of that compiled program ("sticky" faults —
+a corrupted exchange corrupts every step through it), and recovery paths
+re-trace under :func:`suppressed` to get a clean program. When
+``MOMP_CHAOS`` is unset, :func:`active_plan` returns ``None`` and every
+hook degenerates to a single ``is None`` check — no injection ops are
+ever built into a program, no jit-cache key changes, nothing reachable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+
+_HOP_KINDS = ("nan", "inf")
+_HALO_KINDS = ("corrupt", "drop")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A parsed ``MOMP_CHAOS`` spec plus its (tiny) runtime state."""
+
+    raw: str
+    seed: int = 0
+    hop_poison: tuple[str, int] | None = None  # ("nan"|"inf", hop index)
+    halo_fault: str | None = None  # "corrupt" | "drop"
+    delay_s: float = 0.0
+    preempt_step: int | None = None
+    guard: bool = True
+    preempt_fired: bool = False  # in-process refire latch
+
+    @classmethod
+    def parse(cls, raw: str) -> "FaultPlan":
+        plan = cls(raw=raw)
+        for token in raw.split(";"):
+            token = token.strip()
+            if not token:
+                continue
+            key, _, val = token.partition("=")
+            try:
+                if key in ("nan_hop", "inf_hop"):
+                    plan.hop_poison = (key[:3], int(val))
+                elif key == "halo":
+                    if val not in _HALO_KINDS:
+                        raise ValueError(f"want one of {_HALO_KINDS}")
+                    plan.halo_fault = val
+                elif key == "delay":
+                    plan.delay_s = float(val)
+                    if plan.delay_s < 0:
+                        raise ValueError("negative delay")
+                elif key == "preempt":
+                    plan.preempt_step = int(val)
+                elif key == "seed":
+                    plan.seed = int(val)
+                elif key == "noguard" and not val:
+                    plan.guard = False
+                else:
+                    raise ValueError("unknown token")
+            except ValueError as e:
+                raise ValueError(
+                    f"MOMP_CHAOS: bad token {token!r} in {raw!r} ({e})"
+                ) from None
+        return plan
+
+    def preempt_pending(self, step: int) -> bool:
+        """Will the preemption still fire for a run currently at ``step``?
+
+        False once fired in this process, and false when the run already
+        starts at/after the preempt step — a ``--resume`` of the same
+        spec must continue, not re-die at the step it resumed from.
+        """
+        return (
+            self.preempt_step is not None
+            and not self.preempt_fired
+            and step < self.preempt_step
+        )
+
+
+_CACHE: tuple[str | None, FaultPlan | None] = (None, None)
+_SUPPRESS = 0
+
+
+def active_plan() -> FaultPlan | None:
+    """The live :class:`FaultPlan`, or ``None`` when ``MOMP_CHAOS`` is
+    unset/empty or injection is :func:`suppressed`. Cached per spec value
+    so runtime state (the preemption latch) persists across calls."""
+    global _CACHE
+    if _SUPPRESS:
+        return None
+    raw = os.environ.get("MOMP_CHAOS", "")
+    if not raw:
+        return None
+    if _CACHE[0] != raw:
+        _CACHE = (raw, FaultPlan.parse(raw))
+    return _CACHE[1]
+
+
+def reset() -> None:
+    """Drop the cached plan (tests switch specs mid-process)."""
+    global _CACHE
+    _CACHE = (None, None)
+
+
+@contextlib.contextmanager
+def suppressed():
+    """No injection inside: recovery paths re-trace their programs here so
+    a transient fault does not re-fire on the very dispatch that retries
+    it (:func:`active_plan` returns ``None`` within)."""
+    global _SUPPRESS
+    _SUPPRESS += 1
+    try:
+        yield
+    finally:
+        _SUPPRESS -= 1
+
+
+def trace_key(tag: str):
+    """Jit-cache salt for chaos-aware dispatches: a poisoned trace must
+    never be cache-shared with a clean one. ``None`` (the no-chaos key)
+    whenever no plan is active."""
+    plan = active_plan()
+    return None if plan is None else (tag, plan.raw)
+
+
+def hop_poison_spec() -> tuple[str, int] | None:
+    """Trace-time query for the ring fold engines: ``(kind, hop)`` to
+    poison, or ``None`` (no plan / suppressed / no hop fault)."""
+    plan = active_plan()
+    return None if plan is None else plan.hop_poison
+
+
+def poison_hop(kb, vb, j, spec):
+    """Poison a ring hop's K/V partials when ``j`` equals the planned hop.
+
+    ``j`` may be a python int (the final unrolled fold) or a traced loop
+    index: the hit test rides the program as data, so one traced fold
+    body poisons exactly the planned hop at runtime.
+    """
+    import jax.numpy as jnp
+
+    kind, hop = spec
+    bad = jnp.float32(jnp.nan if kind == "nan" else jnp.inf)
+    m = jnp.where(jnp.asarray(j) == hop, bad, jnp.float32(0))
+    return kb + m.astype(kb.dtype), vb + m.astype(vb.dtype)
+
+
+def poisoned_fold(fold, spec):
+    """Wrap a ring fold ``(j, state, kb, vb) -> state`` so the planned
+    hop's K/V arrive poisoned."""
+
+    def wrapped(j, state, kb, vb):
+        kb, vb = poison_hop(kb, vb, j, spec)
+        return fold(j, state, kb, vb)
+
+    return wrapped
+
+
+def halo_ghost_spec() -> tuple[str, int] | None:
+    """Trace-time query for the halo exchange: ``(kind, seed)`` to apply
+    to ghost rows/columns, or ``None``."""
+    plan = active_plan()
+    if plan is None or plan.halo_fault is None:
+        return None
+    return (plan.halo_fault, plan.seed)
+
+
+def corrupt_ghost(ghost, spec):
+    """A faulted ghost block: zeroed ("drop" — the exchange never
+    arrived) or filled with a seeded out-of-range value ("corrupt")."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    kind, seed = spec
+    if kind == "drop":
+        return jnp.zeros_like(ghost)
+    val = int(np.random.default_rng(seed).integers(2, 200))
+    return jnp.full_like(ghost, val)
+
+
+def dispatch_delay() -> float:
+    """Seconds of host-side delay to inject per guarded dispatch (0.0
+    when inactive)."""
+    plan = active_plan()
+    return 0.0 if plan is None else plan.delay_s

@@ -1,0 +1,104 @@
+"""Validation guards and the engine-fallback retry policy.
+
+The generalisation of the engine-keyed retry that ``gated_parity_check``
+(``parallel/context.py``) grew for the Pallas flash kernel: *run a ranked
+list of engines, validate each result, fall through on failure, stamp the
+provenance of whichever engine survived*. Recovery provenance carries the
+``:recovered`` suffix and lands in a process-wide log so recorders
+(``bench.py``) can publish it — a silently self-healed run is a lie in a
+measurement artifact.
+
+Guards sit OUTSIDE the jit boundary on purpose: a validator is a host
+fetch (``all_finite`` pulls the output back), which would serialise the
+async dispatch pipeline if it ran per step on the hot path. They are armed
+only when a chaos plan is active (``MOMP_CHAOS``) or explicitly via
+``MOMP_GUARD=1`` — the default hot path pays a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import os
+
+from mpi_and_open_mp_tpu.robust import chaos
+
+
+class FallbackExhausted(RuntimeError):
+    """Every engine in a :func:`with_fallback` chain failed validation."""
+
+    def __init__(self, notes: list[str]):
+        self.notes = list(notes)
+        super().__init__(
+            "all engines failed: " + ("; ".join(notes) or "(no notes)")
+        )
+
+
+def with_fallback(engines, validator=None, *, retries: int = 1):
+    """Run ``(name, thunk)`` engines in order until one validates.
+
+    ``validator(result) -> bool`` decides acceptance (``None`` accepts the
+    first result that doesn't raise); each engine gets up to ``retries``
+    attempts. Returns ``(result, stamp, notes)`` where ``stamp`` is the
+    engine name — suffixed ``:recovered`` whenever anything failed before
+    it, so provenance distinguishes a first-try pass from a self-healed
+    one. Raises :class:`FallbackExhausted` when the chain runs dry.
+    """
+    notes: list[str] = []
+    clean = True
+    for name, thunk in engines:
+        for _ in range(max(1, retries)):
+            try:
+                result = thunk()
+            except Exception as e:
+                notes.append(f"{name}: {type(e).__name__}: {e}"[:160])
+                clean = False
+                continue
+            if validator is not None:
+                try:
+                    ok = bool(validator(result))
+                except Exception as e:
+                    notes.append(
+                        f"{name} validator: {type(e).__name__}: {e}"[:160])
+                    ok = False
+                if not ok:
+                    if not notes or not notes[-1].startswith(f"{name} "):
+                        notes.append(f"{name} failed validation")
+                    clean = False
+                    continue
+            return result, (name if clean else f"{name}:recovered"), notes
+    raise FallbackExhausted(notes)
+
+
+def all_finite(x) -> bool:
+    """NaN/Inf divergence validator — a full host fetch; guard-path only."""
+    import numpy as np
+    import jax
+
+    return bool(np.isfinite(np.asarray(jax.device_get(x))).all())
+
+
+def guard_env() -> bool:
+    """``MOMP_GUARD=1`` arms the guards without any chaos plan."""
+    return os.environ.get("MOMP_GUARD", "0") == "1"
+
+
+def guards_active() -> bool:
+    """Whether validators should run: an (unsuppressed) chaos plan that
+    didn't opt out via ``noguard``, or the explicit ``MOMP_GUARD=1``."""
+    plan = chaos.active_plan()
+    return (plan is not None and plan.guard) or guard_env()
+
+
+_RECOVERIES: list[str] = []
+
+
+def record_recovery(stamp: str) -> None:
+    """Process-wide recovery provenance (``bench.py`` publishes it)."""
+    _RECOVERIES.append(stamp)
+
+
+def recovery_log() -> list[str]:
+    return list(_RECOVERIES)
+
+
+def clear_recovery_log() -> None:
+    _RECOVERIES.clear()

@@ -1,0 +1,95 @@
+"""Watchdogged device dispatch: probe, bounded backoff, CPU-degrade.
+
+The generalisation of ``bench.py``'s backend probe. The failure mode it
+exists for: a wedged axon relay (observed after a TPU client was killed
+mid-claim) makes ``jax.devices()`` hang indefinitely *in this process
+too* — so device discovery is probed in a subprocess first, and a caller
+whose probes run dry degrades to CPU (honestly labelled via a ``degraded``
+field in its artifact) instead of hanging the harness.
+
+Two hard rules, inherited from the relay's operational history
+(.claude/skills/verify/SKILL.md):
+
+* A hung probe child is ABANDONED, never killed — a killed mid-claim
+  client wedges the relay for hours, right before the measurement the
+  probe exists to protect. The orphan completes harmlessly or fails out
+  on the relay's own clock.
+* Backoff is BOUNDED and deterministic (exponential, capped): an
+  unbounded retry loop against a wedged relay is just a slower hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def probe_once(timeout_s: float) -> tuple[bool, str]:
+    """Can a subprocess finish jax device discovery in time?
+
+    On timeout the child is abandoned un-killed (module docs); its stderr
+    tail rides the failure note — the relay error in it is what an
+    operator needs to diagnose.
+    """
+    with tempfile.TemporaryFile() as err:
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=err,
+        )
+
+        def tail() -> str:
+            err.seek(0)
+            text = err.read().decode(errors="replace").strip()
+            return f": ...{text[-160:]}" if text else ""
+
+        try:
+            rc = child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return False, ("TimeoutExpired: discovery hung; probe "
+                           "abandoned un-killed" + tail())
+        if rc == 0:
+            return True, ""
+        return False, f"probe exit {rc}" + tail()
+
+
+def backoff_schedule(n: int, base_s: float = 2.0,
+                     cap_s: float = 60.0) -> list[float]:
+    """``n`` capped-exponential waits: base, 2·base, 4·base, ... ≤ cap."""
+    return [min(cap_s, base_s * (2 ** i)) for i in range(max(0, n))]
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    ok: bool
+    why: str  # last failure note ("" on success)
+    attempts: int
+    waited_s: float  # total backoff slept
+
+    @property
+    def degraded(self) -> bool:
+        """The one boolean recorders put in their JSON line."""
+        return not self.ok
+
+
+def probe_devices(timeout_s: float, attempts: int = 1,
+                  backoff_s: float = 2.0, cap_s: float = 60.0,
+                  probe=probe_once, sleep=time.sleep) -> ProbeResult:
+    """Probe device discovery up to ``attempts`` times with bounded
+    exponential backoff between failures. ``probe``/``sleep`` are
+    injectable for tests. Never raises: exhaustion is a normal outcome
+    the caller answers with CPU degradation, not an exception."""
+    attempts = max(1, int(attempts))
+    waits = backoff_schedule(attempts - 1, backoff_s, cap_s)
+    why = ""
+    waited = 0.0
+    for a in range(attempts):
+        ok, why = probe(timeout_s)
+        if ok:
+            return ProbeResult(True, "", a + 1, waited)
+        if a < len(waits):
+            sleep(waits[a])
+            waited += waits[a]
+    return ProbeResult(False, why, attempts, waited)

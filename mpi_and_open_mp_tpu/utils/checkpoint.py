@@ -7,11 +7,21 @@ sharded board ``jax.Array`` goes to an Orbax checkpoint directly, so on
 multi-host meshes every process writes only its own shards (no
 gather-to-root, no host bottleneck), and restore can re-shard onto any
 mesh. VTK stays the human-inspectable format; Orbax is the restart format.
+
+Crash safety: ``save`` writes the tree to a ``path + ".tmp"`` sibling and
+``os.replace``s it into place, so a kill mid-save (the preemption this
+format exists to survive) never leaves a half-written restart dir at
+``path`` — readers only ever see the old complete tree or the new one.
+The tree carries a CRC32 manifest leaf of the board bytes; ``restore``
+verifies it and raises ``ValueError`` with a usable message on any
+corrupt/partial/mismatched tree instead of an Orbax traceback.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
+import zlib
 
 import numpy as np
 
@@ -32,22 +42,73 @@ def _checkpointer():
     return _CKPTR
 
 
+def _board_crc(board) -> np.uint32:
+    """CRC32 of the uint8 board bytes — the manifest leaf ``restore``
+    verifies. 0 (= "unverified") on multi-host boards: no single process
+    holds all the bytes, and a per-shard CRC would depend on the mesh."""
+    if not getattr(board, "is_fully_addressable", True):
+        return np.uint32(0)
+    host = np.ascontiguousarray(
+        np.asarray(jax.device_get(board), dtype=np.uint8))
+    return np.uint32(zlib.crc32(host.tobytes()))
+
+
 def save(path: str | os.PathLike, board: jax.Array, step: int) -> None:
-    """Write ``{board, step}`` as an Orbax checkpoint at ``path``."""
+    """Write ``{board, step, crc}`` as an Orbax checkpoint at ``path``,
+    atomically (tmp sibling + rename — module docs)."""
     path = os.path.abspath(os.fspath(path))
+    tmp = path + ".tmp"
+    # A crashed earlier save may have left a stale sibling; it was never
+    # authoritative.
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
     _checkpointer().save(
-        path,
-        {"board": board, "step": np.int64(step)},
+        tmp,
+        {"board": board, "step": np.int64(step), "crc": _board_crc(board)},
         force=True,
     )
+    # os.replace can't overwrite a non-empty dir: clear the old tree
+    # first. A kill in the gap loses only the OLD checkpoint (the new one
+    # sits complete at tmp); no window ever exposes a partial tree.
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
 
 
 def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
-    """Read a checkpoint back to host arrays ``(board, step)``.
+    """Read a checkpoint back to host arrays ``(board, step)``, validated.
 
     The caller re-shards onto its own mesh (``LifeSim(initial_board=...)``);
-    restoring host-side keeps restore mesh-shape-agnostic.
+    restoring host-side keeps restore mesh-shape-agnostic. Raises
+    ``ValueError`` on a missing/corrupt/partial tree or a CRC mismatch.
     """
     path = os.path.abspath(os.fspath(path))
-    tree = _checkpointer().restore(path)
-    return np.asarray(tree["board"], dtype=np.uint8), int(tree["step"])
+    if not os.path.isdir(path):
+        raise ValueError(f"no checkpoint directory at {path}")
+    try:
+        tree = _checkpointer().restore(path)
+    except Exception as e:
+        raise ValueError(
+            f"corrupt or partial checkpoint at {path} "
+            f"({type(e).__name__}: {e})"[:400]) from e
+    if not isinstance(tree, dict) or "board" not in tree or "step" not in tree:
+        raise ValueError(
+            f"checkpoint at {path} is missing its board/step leaves "
+            f"(got {sorted(tree) if isinstance(tree, dict) else type(tree)})")
+    board = np.asarray(tree["board"])
+    if board.ndim != 2:
+        raise ValueError(
+            f"checkpoint board at {path} has rank {board.ndim}, want 2")
+    board = board.astype(np.uint8)
+    step = int(tree["step"])
+    if step < 0:
+        raise ValueError(f"checkpoint at {path} carries negative step {step}")
+    want = int(tree.get("crc", 0))
+    if want:  # 0 = legacy/multi-host tree without a verifiable manifest
+        got = zlib.crc32(np.ascontiguousarray(board).tobytes())
+        if got != want:
+            raise ValueError(
+                f"checkpoint at {path} failed its CRC manifest "
+                f"(stored {want:#010x}, recomputed {got:#010x}) — "
+                "the tree is corrupt; fall back to an earlier step")
+    return board, step

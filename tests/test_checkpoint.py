@@ -109,6 +109,120 @@ def test_checkpoint_uneven_board(tmp_path, make_board):
     np.testing.assert_array_equal(final, oracle_n(board, 10))
 
 
+def test_checkpoint_restore_onto_2x4_and_single_device(tmp_path, make_board):
+    """Save mid-run on the 1x8 row mesh; restore onto a 2x4 cart mesh AND
+    onto a single device (serial) — both finish bit-identical to the
+    oracle. The mesh-shape-agnostic restore contract, explicitly."""
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+
+    board = make_board(48, 40)
+    cfg = config_from_board(board, steps=100, save_steps=0)
+    sim = LifeSim(cfg, layout="row", impl="halo",
+                  mesh=mesh_lib.make_mesh_1d(8, axis="y"))
+    sim.step(60)
+    ck = tmp_path / "ck"
+    sim.save_checkpoint(ck)
+
+    cart = LifeSim.from_checkpoint(ck, cfg, layout="cart", impl="halo",
+                                   mesh=mesh_lib.make_mesh_2d(2, 4))
+    np.testing.assert_array_equal(cart.run(save=False), oracle_n(board, 100))
+    serial = LifeSim.from_checkpoint(ck, cfg, layout="serial", impl="roll")
+    np.testing.assert_array_equal(serial.run(save=False),
+                                  oracle_n(board, 100))
+
+
+def test_resume_mid_run_bit_identity_vs_straight(tmp_path, make_board):
+    """100 straight steps vs 60 + checkpoint + restore + 40: bit-identical
+    to each other and to the NumPy oracle — checkpointing must be
+    invisible to the simulation trajectory."""
+    board = make_board(40, 40)
+    cfg = config_from_board(board, steps=100, save_steps=0)
+    straight = LifeSim(cfg, layout="row", impl="halo").run(save=False)
+
+    sim = LifeSim(cfg, layout="row", impl="halo")
+    sim.step(60)
+    ck = tmp_path / "ck"
+    sim.save_checkpoint(ck)
+    resumed = LifeSim.from_checkpoint(ck, cfg, layout="row", impl="halo")
+    assert resumed.step_count == 60
+    final = resumed.run(save=False)
+    np.testing.assert_array_equal(final, straight)
+    np.testing.assert_array_equal(final, oracle_n(board, 100))
+
+
+def test_save_is_atomic_under_crash(tmp_path, make_board, monkeypatch):
+    """A crash mid-write must leave the OLD complete checkpoint at the
+    path (the partial lands only at the tmp sibling), and the next save
+    must clear the stale sibling and land normally."""
+    import os
+
+    import pytest
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    board = make_board(16, 16)
+    cfg = config_from_board(board, steps=10, save_steps=0)
+    sim = LifeSim(cfg, layout="row", impl="roll")
+    ck = tmp_path / "ck"
+    sim.save_checkpoint(ck)
+    b0, s0 = checkpoint.restore(ck)
+
+    sim.step(5)
+
+    class Boom:
+        def save(self, path, *a, **k):
+            os.makedirs(os.fspath(path), exist_ok=True)  # partial tmp tree
+            raise RuntimeError("simulated crash mid-write")
+
+    with monkeypatch.context() as m:
+        m.setattr(checkpoint, "_checkpointer", lambda: Boom())
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            sim.save_checkpoint(ck)
+    assert os.path.isdir(str(ck) + ".tmp")  # the partial, quarantined
+
+    b1, s1 = checkpoint.restore(ck)  # old tree intact and valid
+    np.testing.assert_array_equal(b1, b0)
+    assert s1 == s0 == 0
+
+    sim.save_checkpoint(ck)  # stale sibling cleared, new save lands
+    _, s2 = checkpoint.restore(ck)
+    assert s2 == 5
+
+
+def test_restore_detects_crc_mismatch(tmp_path, make_board, monkeypatch):
+    """The CRC manifest catches silent corruption: a tree whose stored
+    CRC disagrees with its board bytes is rejected with a usable error."""
+    import pytest
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    cfg = config_from_board(make_board(16, 16), steps=4, save_steps=0)
+    sim = LifeSim(cfg, layout="row", impl="roll")
+    ck = tmp_path / "ck"
+    with monkeypatch.context() as m:
+        m.setattr(checkpoint, "_board_crc",
+                  lambda board: np.uint32(0xDEADBEEF))
+        sim.save_checkpoint(ck)
+    with pytest.raises(ValueError, match="CRC"):
+        checkpoint.restore(ck)
+
+
+def test_restore_corrupt_or_missing_raises_valueerror(tmp_path):
+    """Missing and corrupt trees both surface as ValueError with a clear
+    message, never a raw Orbax traceback."""
+    import pytest
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    with pytest.raises(ValueError, match="no checkpoint directory"):
+        checkpoint.restore(tmp_path / "missing")
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "junk").write_text("not a checkpoint")
+    with pytest.raises(ValueError):
+        checkpoint.restore(bad)
+
+
 def test_checkpoint_resume_bitfused_padded_frame(tmp_path, make_board):
     """Mid-run checkpoint/resume through the packed path on an unaligned
     board: the stored state is the PADDED frame (mirror rows included);
