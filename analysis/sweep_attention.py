@@ -13,7 +13,7 @@ next call's queries so the chain cannot be elided — and difference a
 longer chain (R=9 fwd, R=3 bwd) against R=1, best-of-3 each. TFLOP/s counts 2*h*n^2*d (QK^T + PV, causal
 half). Emits a CSV:
 
-    seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,hop_engine
+    seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,hop_engine,hop_engine_bwd
 
 where `bwd_sec` times one FULL grad step (forward + backward per chain
 link — a backward can't run without its forward), `bwd_tflops` uses
@@ -23,9 +23,11 @@ attention engine+block configuration (e.g. `pallas:b1024`, with a
 row — a mid-sweep fallback is visible in the artifact. `hop_engine`
 records what each K/V hop of a multi-device ring over the same global
 operands would dispatch (`context.ring_hop_engine_for`; `local:`-
-prefixed on a 1-device mesh) — provenance for relating these
-single-chip rates to the ring's per-hop engine, not a timing of the
-ring itself. `--kv-heads` sweeps a GQA/MQA configuration instead
+prefixed on a 1-device mesh) and `hop_engine_bwd` the matching ring
+BACKWARD hop engine (`context.ring_hop_bwd_engine_for` — the
+`ops.flash_hop_bwd` kernels vs the `_flash_block_grads` jnp fold) —
+provenance for relating these single-chip rates to the ring's per-hop
+engines, not a timing of the ring itself. `--kv-heads` sweeps a GQA/MQA configuration instead
 (TFLOP/s still counts the q-heads, which carry the compute).
 
 Usage: python analysis/sweep_attention.py [--out results/attention/attention_tpu.csv]
@@ -175,7 +177,7 @@ def main(argv=None) -> int:
     from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
 
     rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,"
-            "hop_engine"]
+            "hop_engine,hop_engine_bwd"]
 
     def flush() -> None:
         write_csv_rows(args.out, rows)
@@ -194,6 +196,7 @@ def main(argv=None) -> int:
             # in the artifact, not only on stderr.
             engine = context.flash_engine_for(*qkv)
             hop = context.ring_hop_engine_for(*qkv, causal=True)
+            hop_bwd = context.ring_hop_bwd_engine_for(*qkv, causal=True)
             fwd, diff_f = marginal(fwd_chain, qkv)
             if n <= args.bwd_max:
                 # grad runs fwd + bwd; standard fwd+bwd accounting is
@@ -203,9 +206,9 @@ def main(argv=None) -> int:
                 bwd, diff_b = marginal(bwd_chain, qkv, r2=3)
                 return (f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},"
                         f"{bwd:.5f},{3.5 * flops / bwd / 1e12:.1f},"
-                        f"{int(diff_f and diff_b)},{engine},{hop}")
+                        f"{int(diff_f and diff_b)},{engine},{hop},{hop_bwd}")
             return (f"{n},{fwd:.5f},{flops / fwd / 1e12:.1f},,,"
-                    f"{int(diff_f)},{engine},{hop}")
+                    f"{int(diff_f)},{engine},{hop},{hop_bwd}")
 
         try:
             rows.append(point())

@@ -344,13 +344,10 @@ def _bench(args, state) -> int:
                for _ in range(3)]
         # Shape-aware provenance: the engine the timed 32k operands
         # actually dispatch to (a block override that doesn't divide
-        # 32k routes them to jnp even when the gate passed on pallas),
-        # plus the engine each K/V hop of a ring over the same operands
-        # would run (the multi-device flagship path — "jnp" means the
-        # fold oracle, a pallas stamp means the per-hop kernel).
+        # 32k routes them to jnp even when the gate passed on pallas).
+        # The ring-hop stamps (fwd/bwd/zigzag) are emitted in the
+        # report phase so they ride EVERY line, CPU fallback included.
         sharded["attention_engine"] = context.flash_engine_for(*qkv)
-        sharded["attention_hop_engine"] = context.ring_hop_engine_for(
-            *qkv, causal=True)
 
         @jax.jit
         def chain(q, k, v, r):
@@ -432,6 +429,23 @@ def _bench(args, state) -> int:
                 "attention_grad_is_differenced": grad_diff,
             })
     state["phase"] = "report"
+    # Sharded-attention engine provenance rides EVERY bench line — CPU
+    # fallback and the CI bench-contract run included. The stamps are
+    # pure shape analysis over the flagship 32k operands
+    # (ShapeDtypeStructs, never device arrays): the forward hop engine,
+    # the backward hop engine (ops.flash_hop_bwd vs the
+    # _flash_block_grads fold), and the causal-zigzag forward
+    # decomposition. Off-chip they honestly read "jnp"/"local:…", and
+    # the MOMP_RING_HOP / MOMP_RING_HOP_BWD / MOMP_RING_ZZ escape
+    # hatches show up here rather than silently changing the engine.
+    from mpi_and_open_mp_tpu.parallel import context as _ctx
+    _spec = jax.ShapeDtypeStruct((8, 32 * 1024, 128), jax.numpy.bfloat16)
+    sharded["attention_hop_engine"] = _ctx.ring_hop_engine_for(
+        _spec, _spec, _spec, causal=True)
+    sharded["attention_hop_engine_bwd"] = _ctx.ring_hop_bwd_engine_for(
+        _spec, _spec, _spec, causal=True)
+    sharded["attention_hop_engine_zz"] = _ctx.ring_hop_engine_for(
+        _spec, _spec, _spec, causal=True, layout="zigzag")
     # Self-healed dispatches (robust.guards) must surface in the
     # artifact: a silently recovered engine would launder a fault into a
     # clean-looking measurement line.

@@ -258,6 +258,8 @@ def _ring_forward(axis: str, causal: bool, layout: str, q, k, v):
     # same ring schedule, flash-kernel hops, online-softmax merge.
     hop_plan = _ring_hop_plan(q, k, v, causal, layout)
     if hop_plan is not None:
+        if causal and layout == "zigzag":
+            return _ring_forward_hopflash_zz(axis, p, q, k, v, hop_plan)
         return _ring_forward_hopflash(axis, causal, p, q, k, v, hop_plan)
     # Non-causal folds build no masks, so every consumer of the axis
     # index is dead code — and jax 0.4.37's shard_map does not DCE the
@@ -490,6 +492,13 @@ def _ring_flash_bwd(axis: str, causal: bool, layout: str, res, do):
     """
     q, k, v, o, L = res
     p = axis_size(axis)
+    # TPU-eligible hop shapes take the per-hop Pallas backward kernels
+    # instead of the jnp fold below (which remains the oracle and the
+    # ineligible-shape fallback) — same travelling-dk/dv schedule,
+    # kernel-rate per-hop block gradients.
+    bwd_plan = _ring_hop_bwd_plan(q, k, v, causal, layout)
+    if bwd_plan is not None:
+        return _ring_backward_hopflash(axis, causal, p, res, do, bwd_plan)
     # See the forward's note: keep the axis index out of the non-causal
     # trace (its consumers are all dead there and 0.4.37's shard_map
     # leaves the bare partition_id for the SPMD partitioner to reject).
@@ -1073,36 +1082,88 @@ def _plan_stamp(plan) -> str:
 # and the fallback for hop shapes the kernel doesn't take).
 _RING_HOP = os.environ.get("MOMP_RING_HOP", "1") != "0"
 
+# The ring BACKWARD's per-hop engine (the repo-owned hop kernels in
+# ops/flash_hop_bwd — see that module for why the bundled kernel's
+# backward can't serve here). MOMP_RING_HOP_BWD=0 pins the backward
+# hops to the jnp _flash_block_grads fold while the forward hops keep
+# the kernel; MOMP_RING_HOP=0 pins both directions.
+_RING_HOP_BWD = os.environ.get("MOMP_RING_HOP_BWD", "1") != "0"
+
+# Causal-zigzag forward hop dispatch: decompose each hop's live
+# quarter-blocks into kernel calls per half-chunk (hop 0 = causal
+# triangles, later hops = unmasked rectangles) merged through
+# _merge_partials. MOMP_RING_ZZ=0 pins causal zigzag to the jnp fold
+# (the pre-decomposition behaviour).
+_RING_ZZ = os.environ.get("MOMP_RING_ZZ", "1") != "0"
+
 
 @contextlib.contextmanager
 def _ring_hop_pinned(value: bool):
-    """Pin the ring-hop engine gate for one dispatch: the guarded
+    """Pin the ring-hop engine gates for one dispatch: the guarded
     recovery path in :func:`ring_attention` re-dispatches a poisoned
-    fold on the jnp fold oracle by tracing with the hop kernel pinned
-    off (paired with a distinct jit-cache key — the flag is read at
+    fold on the jnp fold oracle by tracing with the hop kernels pinned
+    off — BOTH directions, so the recovered trace is the full jnp fold
+    (paired with a distinct jit-cache key — the flags are read at
     trace time, not part of the cache key)."""
-    global _RING_HOP
-    prev = _RING_HOP
+    global _RING_HOP, _RING_HOP_BWD
+    prev = (_RING_HOP, _RING_HOP_BWD)
     _RING_HOP = value
+    _RING_HOP_BWD = value
     try:
         yield
     finally:
-        _RING_HOP = prev
+        _RING_HOP, _RING_HOP_BWD = prev
 
 
 def _ring_hop_plan(q, k, v, causal: bool, layout: str):
-    """Dispatch plan for the per-hop Pallas ring engine, or ``None``
-    (the jnp fold). Operands are the PER-SHARD ``(h, n_local, d)``
-    blocks, so eligibility — block edges, GQA expand budget — is judged
-    at hop granularity. Causal zigzag stays on the jnp fold: its live
-    quarter-block masks aren't expressible with the kernel's static
-    causal flag (the contiguous ring needs only the flag: hop 0 is the
-    diagonal triangle, every other unskipped hop is fully unmasked)."""
+    """Dispatch plan for the per-hop Pallas ring FORWARD engine, or
+    ``None`` (the jnp fold). Operands are the PER-SHARD
+    ``(h, n_local, d)`` blocks, so eligibility — block edges, GQA
+    expand budget — is judged at hop granularity. The contiguous ring
+    needs only the kernel's static causal flag (hop 0 is the diagonal
+    triangle, every other unskipped hop is fully unmasked); causal
+    zigzag runs HALF-chunk kernel calls (``_ring_forward_hopflash_zz``:
+    hop-0 triangles via the same flag, off-diagonal live pairs fully
+    unmasked), so its eligibility is judged on the ``(h, n_local/2,
+    d)`` half shape — ``MOMP_RING_ZZ=0`` pins it to the jnp fold."""
     if not _RING_HOP:
         return None
     if causal and layout == "zigzag":
-        return None
+        if not _RING_ZZ:
+            return None
+        h, nl, d = q.shape
+        if nl % 2:
+            return None
+        half = nl // 2
+        return _flash_dispatch_plan(
+            jax.ShapeDtypeStruct((h, half, d), q.dtype),
+            jax.ShapeDtypeStruct((k.shape[0], half, d), k.dtype),
+            jax.ShapeDtypeStruct((v.shape[0], half, d), v.dtype))
     return _flash_dispatch_plan(q, k, v)
+
+
+def _ring_hop_bwd_plan(q, k, v, causal: bool, layout: str):
+    """Dispatch plan ``(kind, blk, groups)`` for the per-hop Pallas ring
+    BACKWARD engine (``ops.flash_hop_bwd``), or ``None`` (the jnp
+    ``_flash_block_grads`` fold). Gated by the forward's eligibility
+    machinery — same per-shard block-edge and GQA-expand-budget
+    judgement — with the backward edge capped at the hop kernels' VMEM
+    budget (``flash_hop_bwd.MAX_BLOCK``: the cap keeps dividing the
+    sequence since edges are 128-multiples of powers of two). Causal
+    zigzag stays on the jnp fold: its half-chunk gradient decomposition
+    isn't implemented (the travelling accumulators would need per-half
+    routing), so it is an ineligible shape by definition here."""
+    if not (_RING_HOP and _RING_HOP_BWD):
+        return None
+    if causal and layout == "zigzag":
+        return None
+    plan = _flash_dispatch_plan(q, k, v)
+    if plan is None:
+        return None
+    from mpi_and_open_mp_tpu.ops import flash_hop_bwd
+
+    kind, _, bwd, groups = plan
+    return (kind, min(bwd, flash_hop_bwd.MAX_BLOCK), groups)
 
 
 def _merge_partials(o1, L1, o2, L2):
@@ -1210,18 +1271,197 @@ def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
     return o.astype(q.dtype), _fold_groups(L, hkv, g)
 
 
+def _ring_forward_hopflash_zz(axis: str, p: int, q, k, v, plan):
+    """Causal-zigzag rotate-and-fold with the Pallas kernel as the
+    per-hop engine. Shard ``idx`` holds half-chunks ``(idx, 2p-1-idx)``;
+    the jnp fold's live-pair table (see ``_ring_forward``) decomposes
+    into at most two RECTANGULAR kernel calls per half-chunk per hop,
+    merged through the exact :func:`_merge_partials` combine:
+
+      hop 0 (resident): (lo,lo) and (hi,hi) are the two diagonal
+        TRIANGLES in local coordinates — the kernel's static causal
+        flag; (hi,lo) is a fully unmasked half-square.
+      hop j >= 1 (src != idx): every live pair is fully unmasked —
+        (lo,lo) iff src < idx, (hi,lo) always, (hi,hi) iff src > idx —
+        so the kernel runs maskless and the per-device ``cond``s skip
+        dead pairs entirely (collectives stay outside, as always).
+
+    Same balanced cost as the jnp zigzag fold (~half a full block per
+    hop on EVERY device), kernel-rate arithmetic. Returns ``(o, L)``
+    with the lo‖hi half order and folded GQA ``L`` — exactly the
+    residual layout ``_ring_flash_bwd``'s zigzag branch consumes."""
+    idx = lax.axis_index(axis)
+    hkv = k.shape[0]
+    g = q.shape[0] // hkv
+    nl = q.shape[1]
+    half = nl // 2
+    _, blk, _, groups = plan
+    perm = ring_perm(p, 1)
+    q_lo, q_hi = q[:, :half], q[:, half:]
+
+    # Chaos hook, mirroring _ring_forward_hopflash: the resident hop 0
+    # takes the poison directly; later hops go through the wrapped fold.
+    from mpi_and_open_mp_tpu.robust import chaos as _chaos
+
+    _poison = _chaos.hop_poison_spec()
+    k0, v0 = (_chaos.poison_hop(k, v, 0, _poison)
+              if _poison is not None else (k, v))
+
+    k1 = lax.ppermute(k, axis, perm)
+    v1 = lax.ppermute(v, axis, perm)
+
+    k_lo, k_hi = k0[:, :half], k0[:, half:]
+    v_lo, v_hi = v0[:, :half], v0[:, half:]
+    s_lo = _hop_flash_block(q_lo, k_lo, v_lo, True, blk, groups)
+    s_hi = _hop_flash_block(q_hi, k_lo, v_lo, False, blk, groups)
+    s_hi = _merge_partials(
+        *s_hi, *_hop_flash_block(q_hi, k_hi, v_hi, True, blk, groups))
+
+    def fold(j, state, kb, vb):
+        s_lo, s_hi = state
+        src = (idx - j) % p
+        k_lo, k_hi = kb[:, :half], kb[:, half:]
+        v_lo, v_hi = vb[:, :half], vb[:, half:]
+        s_lo = lax.cond(
+            src < idx,
+            lambda s: _merge_partials(
+                *s, *_hop_flash_block(q_lo, k_lo, v_lo, False, blk,
+                                      groups)),
+            lambda s: s, s_lo)
+        s_hi = _merge_partials(
+            *s_hi, *_hop_flash_block(q_hi, k_lo, v_lo, False, blk, groups))
+        s_hi = lax.cond(
+            src > idx,
+            lambda s: _merge_partials(
+                *s, *_hop_flash_block(q_hi, k_hi, v_hi, False, blk,
+                                      groups)),
+            lambda s: s, s_hi)
+        return s_lo, s_hi
+
+    if _poison is not None:
+        fold = _chaos.poisoned_fold(fold, _poison)
+
+    def hop(j, carry):
+        state, kb, vb = carry
+        kb_next = lax.ppermute(kb, axis, perm)
+        vb_next = lax.ppermute(vb, axis, perm)
+        state = fold(j, state, kb, vb)
+        return state, kb_next, vb_next
+
+    state, kb, vb = lax.fori_loop(1, p - 1, hop, ((s_lo, s_hi), k1, v1))
+    s_lo, s_hi = fold(p - 1, state, kb, vb)
+    o = jnp.concatenate([s_lo[0], s_hi[0]], axis=1).astype(q.dtype)
+    L = jnp.concatenate([s_lo[1], s_hi[1]], axis=1)
+    return o, _fold_groups(L, hkv, g)
+
+
+def _ring_backward_hopflash(axis: str, causal: bool, p: int, res, do,
+                            plan):
+    """The travelling-dk/dv ring backward with the repo-owned Pallas hop
+    kernels (``ops.flash_hop_bwd``) as the per-hop gradient engine
+    (contiguous layout; :func:`_ring_hop_bwd_plan` gated). Identical
+    ring schedule and accumulator contract to the jnp path in
+    ``_ring_flash_bwd`` — K/V make the second ring trip, each block
+    carrying its (dk, dv) accumulator home over ``p`` rotations — but
+    every unskipped hop's (dq, dk, dv) block comes from the two kernel
+    launches instead of the ``_flash_block_grads`` fold. Hop 0 is
+    peeled out of the ``fori_loop``: it is the one hop whose causal
+    mask is the local diagonal triangle (the kernels' static ``causal``
+    flag); every later unskipped hop (``src < idx``) runs maskless.
+
+    The per-row statistics are hop-invariant, so ``L`` (unfolded from
+    the residual's folded GQA layout to per-q-head rows) and ``D =
+    rowsum(do·o)`` are lane-broadcast ONCE outside the loop. GQA K/V
+    expand per hop inside the taken branch (plan-budgeted, like the
+    forward hop engine); dk/dv come back per-q-head and are group-summed
+    into the (hkv, ...) travelling accumulators."""
+    from mpi_and_open_mp_tpu.ops import flash_hop_bwd
+
+    q, k, v, o, L = res
+    idx = lax.axis_index(axis) if causal else 0
+    nl, d = q.shape[1:]
+    hkv = k.shape[0]
+    g = q.shape[0] // hkv
+    f32 = jnp.float32
+    perm = ring_perm(p, 1)
+    _, blk, groups = plan
+
+    D = jnp.sum(do.astype(f32) * o.astype(f32), axis=-1)  # (h, nl)
+    L128 = flash_hop_bwd.lane_broadcast(_unfold_groups(L, hkv, g))
+    D128 = flash_hop_bwd.lane_broadcast(D)
+
+    def kernel_contrib(kb, vb, diag: bool):
+        kbx, vbx = _repeat_heads(kb, vb, groups)
+        dqh, dkh, dvh = flash_hop_bwd.hop_block_grads(
+            q, do, L128, D128, kbx, vbx, causal=diag and causal,
+            blk=blk, interpret=_PALLAS_INTERPRET)
+        if g > 1:
+            dkh = dkh.reshape(hkv, g, nl, d).sum(axis=1)
+            dvh = dvh.reshape(hkv, g, nl, d).sum(axis=1)
+        # The hop loop carries dq in the folded GQA layout (it is
+        # unfolded once at the end, like the jnp path's).
+        return _fold_groups(dqh, hkv, g), dkh, dvh
+
+    def zero3(_):
+        return (jnp.zeros((hkv, nl * g, d), f32),
+                jnp.zeros((hkv, nl, d), f32),
+                jnp.zeros((hkv, nl, d), f32))
+
+    # Hop 0: resident diagonal block, double-buffered like the forward
+    # (first rotation issued before the kernel launches).
+    k1 = lax.ppermute(k, axis, perm)
+    v1 = lax.ppermute(v, axis, perm)
+    dq0, dk0, dv0 = kernel_contrib(k, v, True)
+    dkb = lax.ppermute(dk0, axis, perm)
+    dvb = lax.ppermute(dv0, axis, perm)
+
+    def contribute(j, kb, vb):
+        # j >= 1 only: never the diagonal, so either fully unmasked or
+        # entirely in the future and skipped (contiguous causal). The
+        # ppermutes stay outside the cond (collectives in a per-device
+        # branch would deadlock the ring).
+        if not causal:
+            return kernel_contrib(kb, vb, False)
+        src = (idx - j) % p
+        return lax.cond(
+            src < idx, lambda _: kernel_contrib(kb, vb, False), zero3,
+            None)
+
+    def hop(j, carry):
+        dq, kb, vb, dkb, dvb = carry
+        kb_next = lax.ppermute(kb, axis, perm)
+        vb_next = lax.ppermute(vb, axis, perm)
+        dqj, dkj, dvj = contribute(j, kb, vb)
+        dkb = lax.ppermute(dkb + dkj, axis, perm)
+        dvb = lax.ppermute(dvb + dvj, axis, perm)
+        return dq + dqj, kb_next, vb_next, dkb, dvb
+
+    dq, kb, vb, dkb, dvb = lax.fori_loop(
+        1, p - 1, hop, (dq0, k1, v1, dkb, dvb))
+    # Last block, then the p-th accumulator rotation lands every
+    # (dk, dv) back on its home shard (hop-0 peel + p-2 loop rotations
+    # + this one = p, same count as the jnp path).
+    dqj, dkj, dvj = contribute(p - 1, kb, vb)
+    dq = dq + dqj
+    dk = lax.ppermute(dkb + dkj, axis, perm)
+    dv = lax.ppermute(dvb + dvj, axis, perm)
+    dq = _unfold_groups(dq, hkv, g).astype(q.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def ring_hop_engine_for(q, k, v, *, p: int | None = None,
                         causal: bool = True,
                         layout: str = "contiguous") -> str:
     """Shape-aware provenance for the MULTI-DEVICE ring fold: the engine
     each K/V hop of a ``ring_attention`` over these GLOBAL operands
-    will run — a ``pallas:b…`` stamp (per-hop kernel) or ``"jnp"`` (the
-    fold oracle). ``p`` defaults to the local device count (what
-    ``ring_attention``'s default mesh uses). A 1-device ring never
-    enters the ring body; its local engine is reported as
-    ``"local:<flash_engine_for stamp>"``. Recorders publishing ring
-    timings must stamp artifacts with this, exactly as single-device
-    recorders stamp :func:`flash_engine_for`."""
+    will run — a ``pallas:b…`` stamp (per-hop kernel; ``:zz`` marks the
+    causal-zigzag half-chunk decomposition, whose block edge is sized
+    for the half shape) or ``"jnp"`` (the fold oracle). ``p`` defaults
+    to the local device count (what ``ring_attention``'s default mesh
+    uses). A 1-device ring never enters the ring body; its local engine
+    is reported as ``"local:<flash_engine_for stamp>"``. Recorders
+    publishing ring timings must stamp artifacts with this, exactly as
+    single-device recorders stamp :func:`flash_engine_for`."""
     if p is None:
         p = len(jax.devices())
     h, n, d = q.shape
@@ -1232,7 +1472,45 @@ def ring_hop_engine_for(q, k, v, *, p: int | None = None,
     sk = jax.ShapeDtypeStruct((k.shape[0], nl, d), k.dtype)
     sv = jax.ShapeDtypeStruct((v.shape[0], nl, d), v.dtype)
     plan = _ring_hop_plan(sq, sk, sv, causal, layout)
-    return "jnp" if plan is None else _plan_stamp(plan)
+    if plan is None:
+        return "jnp"
+    stamp = _plan_stamp(plan)
+    if causal and layout == "zigzag":
+        stamp += ":zz"
+    return stamp
+
+
+def ring_hop_bwd_engine_for(q, k, v, *, p: int | None = None,
+                            causal: bool = True,
+                            layout: str = "contiguous") -> str:
+    """Shape-aware provenance for the ring BACKWARD's per-hop engine:
+    ``pallas:b…`` when each hop's (dq, dk, dv) block runs the
+    ``ops.flash_hop_bwd`` kernels (``:kvx…`` for the per-hop GQA
+    expand), ``"jnp"`` for the ``_flash_block_grads`` fold (causal
+    zigzag, ineligible hop shapes, or ``MOMP_RING_HOP_BWD=0`` /
+    ``MOMP_RING_HOP=0``). The stamped block edge is the hop kernels'
+    effective one — the single-device backward edge capped at
+    ``flash_hop_bwd.MAX_BLOCK``. A 1-device ring reports its local
+    engine (whose stamp already carries the kernel backward edge when
+    it differs). Recorders publishing ring GRADIENT timings must stamp
+    artifacts with this, alongside :func:`ring_hop_engine_for`."""
+    if p is None:
+        p = len(jax.devices())
+    h, n, d = q.shape
+    if p == 1:
+        return "local:" + flash_engine_for(q, k, v)
+    nl = n // p
+    sq = jax.ShapeDtypeStruct((h, nl, d), q.dtype)
+    sk = jax.ShapeDtypeStruct((k.shape[0], nl, d), k.dtype)
+    sv = jax.ShapeDtypeStruct((v.shape[0], nl, d), v.dtype)
+    plan = _ring_hop_bwd_plan(sq, sk, sv, causal, layout)
+    if plan is None:
+        return "jnp"
+    kind, blk, groups = plan
+    stamp = f"pallas:b{blk}"
+    if kind == "expand":
+        stamp += f":kvx{groups}"
+    return stamp
 
 
 def _pallas_flash(q, k, v, causal: bool) -> jnp.ndarray:

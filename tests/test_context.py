@@ -835,13 +835,20 @@ def test_ring_hop_engine_routing(monkeypatch):
     # GQA hops expand locally per hop; the stamp says so.
     assert (context.ring_hop_engine_for(*qkv(hkv=2), p=8)
             == "pallas:b1024:kvx2")
-    # Causal zigzag's quarter-block masks aren't expressible with the
-    # kernel's static causal flag: stays on the jnp fold. Non-causal
-    # zigzag has no masks, so it may take the kernel.
+    # Causal zigzag decomposes each hop into half-chunk kernel calls:
+    # eligibility and block edge are judged on the (h, nl/2, d) half
+    # shape and the stamp says so (1k hop blocks -> 512 halves).
+    # Non-causal zigzag has no masks, so it takes the contiguous form.
     assert context.ring_hop_engine_for(
-        *qkv(), p=8, causal=True, layout="zigzag") == "jnp"
+        *qkv(), p=8, causal=True, layout="zigzag") == "pallas:b512:zz"
     assert context.ring_hop_engine_for(
         *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b1024"
+    # MOMP_RING_ZZ=0 pins causal zigzag (and only it) to the jnp fold.
+    monkeypatch.setattr(context, "_RING_ZZ", False)
+    assert context.ring_hop_engine_for(
+        *qkv(), p=8, causal=True, layout="zigzag") == "jnp"
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    monkeypatch.setattr(context, "_RING_ZZ", True)
     # Hop blocks that fail the kernel predicate (seq % 128) fall back.
     assert context.ring_hop_engine_for(*qkv(n=8 * 1000), p=8) == "jnp"
     # A 1-device ring never enters the ring body: local provenance.
@@ -850,6 +857,60 @@ def test_ring_hop_engine_routing(monkeypatch):
     # Kill switch pins the ring to the jnp fold oracle.
     monkeypatch.setattr(context, "_RING_HOP", False)
     assert context.ring_hop_engine_for(*qkv(), p=8) == "jnp"
+
+
+def test_ring_hop_bwd_engine_routing(monkeypatch):
+    """ring_hop_bwd_engine_for: the ring BACKWARD's per-hop provenance —
+    the repo-owned hop kernels on eligible contiguous hop shapes (edge
+    capped at flash_hop_bwd.MAX_BLOCK), the jnp _flash_block_grads fold
+    for causal zigzag / ineligible shapes / under MOMP_RING_HOP_BWD=0
+    or MOMP_RING_HOP=0, the local engine at p=1."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    def qkv(h=4, hkv=4, n=8192, d=128):
+        q = jnp.zeros((h, n, d), jnp.bfloat16)
+        k = jnp.zeros((hkv, n, d), jnp.bfloat16)
+        return q, k, jnp.zeros((hkv, n, d), jnp.bfloat16)
+
+    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "jnp"
+
+    monkeypatch.setattr(context.jax, "default_backend", lambda: "tpu")
+    # 1k hop blocks: the forward edge is b1024, the hop backward caps
+    # at the kernels' VMEM-budget MAX_BLOCK (512).
+    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "pallas:b512"
+    # GQA hops expand per hop, like the forward engine.
+    assert (context.ring_hop_bwd_engine_for(*qkv(hkv=2), p=8)
+            == "pallas:b512:kvx2")
+    # Causal zigzag gradients stay on the jnp fold (the half-chunk
+    # decomposition is forward-only); non-causal zigzag is maskless.
+    assert context.ring_hop_bwd_engine_for(
+        *qkv(), p=8, causal=True, layout="zigzag") == "jnp"
+    assert context.ring_hop_bwd_engine_for(
+        *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b512"
+    assert context.ring_hop_bwd_engine_for(*qkv(n=8 * 1000), p=8) == "jnp"
+    assert (context.ring_hop_bwd_engine_for(*qkv(), p=1)
+            == "local:pallas:b512")
+    # MOMP_RING_HOP_BWD=0: backward hops fold, forward hops keep the
+    # kernel. MOMP_RING_HOP=0 pins both.
+    monkeypatch.setattr(context, "_RING_HOP_BWD", False)
+    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "jnp"
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    monkeypatch.setattr(context, "_RING_HOP_BWD", True)
+    monkeypatch.setattr(context, "_RING_HOP", False)
+    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "jnp"
+
+
+def test_ring_hop_pinned_pins_both_directions():
+    """The chaos-recovery pin (_ring_hop_pinned(False)) must pin BOTH
+    hop engines: the :recovered re-dispatch promises the full jnp fold
+    oracle, forward and backward."""
+    from mpi_and_open_mp_tpu.parallel import context
+
+    assert context._RING_HOP and context._RING_HOP_BWD
+    with context._ring_hop_pinned(False):
+        assert not context._RING_HOP
+        assert not context._RING_HOP_BWD
+    assert context._RING_HOP and context._RING_HOP_BWD
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -932,3 +993,180 @@ def test_pallas_flash_interpret_shard_map_single_device(rng,
     want = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# The ring BACKWARD hop kernels and the causal-zigzag forward hop
+# dispatch (tentpole): block-level kernel parity vs the jnp oracle
+# arithmetic, end-to-end interpret parity on the virtual mesh, and the
+# MOMP_RING_HOP_BWD / MOMP_RING_ZZ escape hatches.
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blk", [128, 256])
+def test_hop_flash_block_grads_kernel_parity(rng, causal, blk):
+    """ops.flash_hop_bwd.hop_block_grads (interpret mode, multi-tile
+    grids included) against _flash_block_grads — THE jnp oracle
+    arithmetic every ring hop gradient folds with. Same L/D statistics,
+    same masking semantics, so the kernels may replace the fold
+    block-for-block."""
+    from mpi_and_open_mp_tpu.ops import flash_hop_bwd
+    from mpi_and_open_mp_tpu.parallel.context import (
+        _flash_block_grads, _mask_from_pos)
+
+    h, n, d = 2, 256, 128
+    scale = 1.0 / np.sqrt(d)
+    q, k, v, do = (jnp.asarray(rng.standard_normal((h, n, d)),
+                               jnp.float32) for _ in range(4))
+    s = jnp.einsum("hqd,hkd->hqk", q, k) * scale
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool)), s, -1e30)
+    L = jax.scipy.special.logsumexp(s, axis=-1)
+    o = jnp.einsum("hqk,hkd->hqd", jnp.exp(s - L[..., None]), v)
+    D = jnp.sum(do * o, axis=-1)
+
+    pos = jnp.arange(n)
+    mask = _mask_from_pos(pos, pos, None, causal)
+    want = _flash_block_grads(q, do, L, D, k, v, mask, scale)
+    got = flash_hop_bwd.hop_block_grads(
+        q, do, flash_hop_bwd.lane_broadcast(L),
+        flash_hop_bwd.lane_broadcast(D), k, v, causal=causal, blk=blk,
+        interpret=True)
+    for name, a, b in zip("dq dk dv".split(), got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("hkv", [4, 2])
+def test_ring_hop_bwd_kill_switch_matches_kernel(rng, sp_mesh,
+                                                 pallas_interpret, hkv):
+    """MOMP_RING_HOP_BWD=0 must reach the jnp _flash_block_grads fold
+    while the FORWARD hops keep the kernel — and the two backward
+    engines must agree on the gradients (the fold is the kernel path's
+    parity oracle). hkv=2 exercises the per-hop GQA expand and the
+    group-summed travelling accumulators."""
+    context = pallas_interpret
+    h, n, d = 4, 8 * 128, 128
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    p = sp_mesh.shape["sp"]
+
+    want_stamp = "pallas:b128" if hkv == h else "pallas:b128:kvx2"
+    assert context.ring_hop_bwd_engine_for(
+        q, k, v, p=p, causal=True) == want_stamp
+
+    def loss(q_, k_, v_):
+        return jnp.sum(
+            ring_attention(q_, k_, v_, mesh=sp_mesh, causal=True) ** 2)
+
+    g_kernel = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    try:
+        context._RING_HOP_BWD = False
+        jax.clear_caches()
+        assert context.ring_hop_bwd_engine_for(
+            q, k, v, p=p, causal=True) == "jnp"
+        assert context.ring_hop_engine_for(
+            q, k, v, p=p, causal=True).startswith("pallas:")
+        g_fold = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        context._RING_HOP_BWD = True
+        jax.clear_caches()
+    for name, a, b in zip("dq dk dv".split(), g_kernel, g_fold):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("hkv", [2, 1])
+def test_ring_zigzag_hopflash_interpret_parity(rng, sp_mesh,
+                                               pallas_interpret, hkv):
+    """Causal zigzag with the per-hop Pallas engine engaged (interpret
+    mode, 8-virtual-device mesh): the half-chunk kernel decomposition
+    must match the dense oracle AND the jnp zigzag fold it replaced
+    (MOMP_RING_ZZ=0), forward and grads — the grads additionally prove
+    the lo‖hi (o, L) residual handoff to the zigzag jnp backward."""
+    from mpi_and_open_mp_tpu.parallel.context import (
+        zigzag_shard, zigzag_unshard)
+
+    context = pallas_interpret
+    h, d = 2, 128
+    p = sp_mesh.shape["sp"]
+    n = p * 256  # 256-token shards -> 128-token halves: interpret-eligible
+    q = jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
+
+    stamp = context.ring_hop_engine_for(q, k, v, p=p, causal=True,
+                                        layout="zigzag")
+    assert stamp == ("pallas:b128:zz" if hkv == h else "pallas:b128:kvx2:zz")
+    # Zigzag gradients stay on the jnp fold — truthful provenance.
+    assert context.ring_hop_bwd_engine_for(
+        q, k, v, p=p, causal=True, layout="zigzag") == "jnp"
+
+    qz, kz, vz = (zigzag_shard(x, p) for x in (q, k, v))
+    got = zigzag_unshard(
+        ring_attention(qz, kz, vz, mesh=sp_mesh, causal=True,
+                       layout="zigzag"), p)
+    kr = jnp.repeat(k, h // hkv, axis=0)
+    vr = jnp.repeat(v, h // hkv, axis=0)
+    want = attention_reference(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+    def loss(q_, k_, v_):
+        return jnp.sum(ring_attention(q_, k_, v_, mesh=sp_mesh,
+                                      causal=True, layout="zigzag") ** 2)
+
+    g_kernel = jax.grad(loss, argnums=(0, 1, 2))(qz, kz, vz)
+    # MOMP_RING_ZZ=0: the jnp zigzag fold, fwd + grads, must agree.
+    try:
+        context._RING_ZZ = False
+        jax.clear_caches()
+        assert context.ring_hop_engine_for(
+            q, k, v, p=p, causal=True, layout="zigzag") == "jnp"
+        fold = zigzag_unshard(
+            ring_attention(qz, kz, vz, mesh=sp_mesh, causal=True,
+                           layout="zigzag"), p)
+        g_fold = jax.grad(loss, argnums=(0, 1, 2))(qz, kz, vz)
+    finally:
+        context._RING_ZZ = True
+        jax.clear_caches()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(fold),
+                               rtol=1e-4, atol=1e-4)
+    for name, a, b in zip("dq dk dv".split(), g_kernel, g_fold):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3, err_msg=name)
+
+
+def test_ring_hop_engines_chaos_recovery_interplay(rng, sp_mesh,
+                                                   pallas_interpret,
+                                                   monkeypatch):
+    """Chaos-recovery interplay with BOTH hop engines engaged: a
+    NaN-poisoned kernel hop must re-dispatch onto the full jnp fold
+    oracle (the _ring_hop_pinned(False) recovery trace pins forward AND
+    backward kernels off), land finite with oracle parity, and record
+    the ``:recovered`` stamp."""
+    from mpi_and_open_mp_tpu.robust import chaos, guards
+
+    context = pallas_interpret
+    h, n, d = 2, 8 * 128, 128
+    q, k, v = (jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+               for _ in range(3))
+    assert context.ring_hop_engine_for(
+        q, k, v, p=sp_mesh.shape["sp"], causal=True).startswith("pallas:")
+
+    monkeypatch.setenv("MOMP_CHAOS", "nan_hop=2;seed=7")
+    chaos.reset()
+    guards.clear_recovery_log()
+    try:
+        out = ring_attention(q, k, v, mesh=sp_mesh, causal=True)
+    finally:
+        monkeypatch.delenv("MOMP_CHAOS")
+        chaos.reset()
+        jax.clear_caches()
+    assert np.isfinite(np.asarray(out)).all()
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    assert any(s.startswith("ring_attention:jnp:recovered")
+               for s in guards.recovery_log())

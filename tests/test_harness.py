@@ -204,6 +204,13 @@ def test_bench_cpu_end_to_end(capsys, monkeypatch):
     named = rec["chip_record"].split()[0]
     assert os.path.exists(os.path.join(REPO, named)), named
     assert "error" not in rec and "sharded_steady_cups" not in rec
+    # The ring-hop engine provenance (fwd / bwd / zigzag) rides EVERY
+    # line, CPU fallback included — honest "jnp"-family stamps here.
+    for key in ("attention_hop_engine", "attention_hop_engine_bwd",
+                "attention_hop_engine_zz"):
+        stamp = rec[key]
+        assert stamp == "jnp" or stamp.startswith(("local:", "pallas:")), (
+            key, stamp)
 
 
 def test_native_path_matches_dispatcher_gates():
