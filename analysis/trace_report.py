@@ -1,0 +1,55 @@
+"""Trace-report CLI: summarise a ``MOMP_TRACE`` JSONL file.
+
+Usage::
+
+    python analysis/trace_report.py /tmp/trace.jsonl          # text tables
+    python analysis/trace_report.py /tmp/trace.jsonl --json   # machine form
+
+Text mode prints the per-span phase breakdown, the ring-attention hop
+summary (span counts, engines, α+βn transfer fit when the trace carries
+two or more hop sizes), recoveries by stamp, and the jit-retrace counters
+from the last ``metrics`` snapshot event. ``--json`` emits the same data
+as one JSON object (``obs.report.report_dict`` schema) — what the CI
+trace cycle asserts against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# A trace file is host-side data; nothing here needs (or should claim)
+# the TPU. The fit path imports jax transitively, so pin the platform
+# before any package import — the sitecustomize default is the TPU
+# plugin, and a second TPU process would fight the real workload.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_and_open_mp_tpu.obs import report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="analysis/trace_report.py")
+    p.add_argument("trace", help="MOMP_TRACE JSONL file to summarise")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object")
+    args = p.parse_args(argv)
+
+    try:
+        records = report.load(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+    rep = report.report_dict(records)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(report.render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ held 1.25-1.29e12). End-to-end time/rate stay as secondary fields.
 import argparse
 import functools
 import json
+import os
 import sys
 import time
 
@@ -41,8 +42,6 @@ def _probe_devices(timeout_s: float) -> tuple[bool, str]:
 
 
 def _env_num(name: str, default, cast):
-    import os
-
     try:
         return cast(os.environ.get(name, default))
     except ValueError:
@@ -118,9 +117,18 @@ def main(argv=None) -> int:
     ap.add_argument("--resume", action="store_true",
                     help="continue the checkpointed phase from the latest "
                     "restart point in --checkpoint-dir")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write obs span/event JSONL here (sets MOMP_TRACE; "
+                    "summarise with analysis/trace_report.py). The timed "
+                    "brackets carry no trace hooks — steady-state numbers "
+                    "are unaffected by construction")
     args = ap.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
+    if args.trace:
+        # Before any phase runs, so the sink (append-mode, cached per env
+        # value) collects every span of this invocation.
+        os.environ["MOMP_TRACE"] = args.trace
     global NY, NX, STEPS
     if args.board:
         NY = NX = args.board
@@ -161,6 +169,8 @@ def _bench(args, state) -> int:
     # BENCH_PROBE_ATTEMPTS asks for retries, and fall back to CPU
     # (honestly labelled) so the bench records a line instead of hanging
     # the harness.
+    from mpi_and_open_mp_tpu.obs import metrics as obs_metrics
+    from mpi_and_open_mp_tpu.obs import trace as obs_trace
     from mpi_and_open_mp_tpu.robust import guards, watchdog
 
     backend_note = {}
@@ -196,7 +206,11 @@ def _bench(args, state) -> int:
     state["phase"] = "parity"
     cfg_check = config_from_board(board, steps=8, save_steps=0)
     sim_check = LifeSim(cfg_check, layout="serial", impl="auto")
-    got = sim_check.run(save=False)
+    # Phase spans (no-op singletons when MOMP_TRACE is unset) bracket the
+    # UNTIMED phases only; the chained-dispatch brackets inside measure()
+    # stay hook-free so tracing cannot perturb the recorded rates.
+    with obs_trace.span("bench.phase", phase="parity"):
+        got = sim_check.run(save=False)
     ref = board.copy()
     for _ in range(8):
         ref = life_step_numpy(ref)
@@ -213,7 +227,8 @@ def _bench(args, state) -> int:
     ckpt_fields = {}
     if args.checkpoint_dir:
         state["phase"] = "checkpoint"
-        ckpt_fields = _checkpointed_run(args)
+        with obs_trace.span("bench.phase", phase="checkpoint"):
+            ckpt_fields = _checkpointed_run(args)
 
     state["phase"] = "measure"
 
@@ -265,7 +280,8 @@ def _bench(args, state) -> int:
 
     cfg = config_from_board(board, steps=STEPS, save_steps=0)
     sim = LifeSim(cfg, layout="serial", impl="auto")
-    best, steady, differenced = measure(sim)
+    with obs_trace.span("bench.phase", phase="measure"):
+        best, steady, differenced = measure(sim)
     cups = NY * NX * STEPS / best
     steady_cups = NY * NX * STEPS / steady
 
@@ -446,6 +462,38 @@ def _bench(args, state) -> int:
         _spec, _spec, _spec, causal=True)
     sharded["attention_hop_engine_zz"] = _ctx.ring_hop_engine_for(
         _spec, _spec, _spec, causal=True, layout="zigzag")
+    # Trace probe (only when a MOMP_TRACE sink is set): the attention
+    # phase above is TPU-only, so a CPU bench run would otherwise produce
+    # a trace with no ring spans at all — and the CI trace cycle asserts
+    # on exactly those. One tiny ring_attention over the default mesh
+    # exercises the traced hop-by-hop dispatch (chaos-free: 2*(p-1) hop
+    # spans) or the guarded path (active chaos plan: a recovery event),
+    # in milliseconds at this shape. Failures cost a field, never the
+    # bench line.
+    trace_fields = {}
+    if obs_trace.enabled():
+        try:
+            from mpi_and_open_mp_tpu.parallel import context as _pctx
+            from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+            p_dev = jax.device_count()
+            prng = np.random.default_rng(7)
+            h, n, d = 4, 64 * p_dev, 32
+            qkv_t = [jax.numpy.asarray(
+                prng.standard_normal((h, n, d)), jax.numpy.float32)
+                for _ in range(3)]
+            anchor_sync(_pctx.ring_attention(*qkv_t, causal=True),
+                        fetch_all=True)
+            trace_fields["trace_probe"] = f"ring_attention p={p_dev}"
+        except Exception as e:
+            trace_fields["trace_probe_error"] = (
+                f"{type(e).__name__}: {e}"[:200])
+    # The registry snapshot rides the line (retraces, hop counts, guard
+    # ladder, checkpoint totals) and — when tracing — lands in the trace
+    # stream too, so trace_report can summarise retraces offline.
+    obs_trace.event("metrics", snapshot=obs_metrics.snapshot())
+    metrics_fields = ({"metrics": obs_metrics.snapshot()}
+                      if obs_metrics.metrics_on() else {})
     # Self-healed dispatches (robust.guards) must surface in the
     # artifact: a silently recovered engine would launder a fault into a
     # clean-looking measurement line.
@@ -470,6 +518,8 @@ def _bench(args, state) -> int:
         **({"recovered": recovered} if recovered else {}),
         **ckpt_fields,
         **sharded,
+        **trace_fields,
+        **metrics_fields,
         **backend_note,
     }))
     return 0

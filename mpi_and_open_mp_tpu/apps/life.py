@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "SIGTERM flushes one and exits 75)")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the run into DIR")
+    p.add_argument("--trace", metavar="PATH", default=None,
+                   help="write obs span/event JSONL here (sets MOMP_TRACE; "
+                        "read it back with analysis/trace_report.py)")
     p.add_argument("--debug-check", action="store_true",
                    help="assert halo-exchange consistency vs the oracle "
                         "before and after the run")
@@ -112,6 +115,12 @@ def make_mesh(args):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     apply_platform_args(args)
+    if args.trace:
+        # Before any sim work so every span of this run lands in the sink
+        # (the sink is cached per env value; appends across invocations).
+        os.environ["MOMP_TRACE"] = args.trace
+    from mpi_and_open_mp_tpu.obs import trace
+
     cfg = load_config(args.cfg)
     kwargs = dict(
         layout=args.layout,
@@ -162,7 +171,17 @@ def main(argv=None) -> int:
     with ctx:
         t0 = time.perf_counter()
         try:
-            final = sim.run()  # collect() inside forces device completion
+            # The whole-run root span: segments/advances nest under it; a
+            # Preempted exit closes it with an error attr, so the trace
+            # still shows how far the run got.
+            with trace.span(
+                "life.run",
+                cfg=os.path.basename(args.cfg),
+                steps=cfg.steps,
+                impl=sim.impl,
+                layout=sim.layout,
+            ):
+                final = sim.run()  # collect() inside forces completion
         except Preempted as e:
             # EX_TEMPFAIL: the queue keeps the job; --resume continues
             # from the flushed checkpoint (docs/MIGRATION.md workflow).

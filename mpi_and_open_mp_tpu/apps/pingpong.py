@@ -3,7 +3,9 @@
 Contract (reference ``2-network-params/mpi_send_recv.c:36-39``): one
 ``size,time`` CSV row per message size on stdout (µs per hop), consumable by
 the reference's ``plot.ipynb`` α+βn analysis. ``--fit`` additionally prints
-the fitted latency α (µs) and bandwidth 1/β (MB/s) to stderr.
+the fitted latency α (µs) and bandwidth 1/β (MB/s) to stderr, plus one
+machine-readable ``{"metric": "pingpong_fit", ...}`` JSON line as the last
+stdout line (``Fit.as_json`` schema).
 """
 
 from __future__ import annotations
@@ -39,7 +41,14 @@ def main(argv=None) -> int:
         if args.out:
             fabric.write_csv(args.out, rows)
         if args.fit:
-            print(fabric.fit_alpha_beta(rows).render(), file=sys.stderr)
+            import json
+
+            fit = fabric.fit_alpha_beta(rows)
+            print(fit.render(), file=sys.stderr)
+            # Machine-readable twin of the stderr render, as the LAST
+            # stdout line: harnesses take the CSV rows above verbatim and
+            # json-parse this one (same tail-line discipline as bench.py).
+            print(json.dumps({"metric": "pingpong_fit", **fit.as_json()}))
     return 0
 
 

@@ -118,6 +118,17 @@ def _ceil_to(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def _note_retrace(fn: str) -> None:
+    """Retrace accounting (``obs.metrics``): called from INSIDE jitted
+    ``advance`` bodies, which only execute on a jit-cache miss — so the
+    counter reads "how many distinct programs XLA built for this
+    function", the number that explains a slow first segment or a
+    shape-churn pathology. Free at execution time by construction."""
+    from mpi_and_open_mp_tpu.obs import metrics
+
+    metrics.inc("jit.retrace", fn=fn)
+
+
 class LifeSim:
     """One Life run: sharded board state + compiled steppers + snapshot IO."""
 
@@ -308,6 +319,8 @@ class LifeSim:
 
             @functools.partial(jax.jit, static_argnums=1)
             def advance(board, n):
+                _note_retrace("life_advance_roll")
+
                 def body(_, b):
                     if pad_y or pad_x:
                         v = life_ops.life_step_roll(b[:ny, :nx])
@@ -342,6 +355,7 @@ class LifeSim:
 
         @functools.partial(jax.jit, static_argnums=1)
         def advance(board, n):
+            _note_retrace("life_advance_halo")
             rounds, rem = divmod(n, k)
             board = lax.fori_loop(0, rounds, lambda _, b: smapped_k(b), board)
             if rem:
@@ -403,6 +417,7 @@ class LifeSim:
 
             @jax.jit
             def advance(board, n):
+                _note_retrace("life_advance_bitfused")
                 out = life_run_vmem(board[:ny, :nx], jnp.int32(n))
                 out = jnp.pad(out, ((0, fy - ny), (0, fx - nx)))
                 return lax.with_sharding_constraint(
@@ -449,6 +464,7 @@ class LifeSim:
 
         @jax.jit
         def advance(board, n):
+            _note_retrace("life_advance_bitfused")
             return smapped(board, jnp.int32(n))
 
         return advance
@@ -752,6 +768,7 @@ class LifeSim:
         step) or fire a simulated preemption at a fixed step; guards are
         armed by the plan or ``MOMP_GUARD=1``.
         """
+        from mpi_and_open_mp_tpu.obs import trace
         from mpi_and_open_mp_tpu.robust import chaos, guards, preempt
 
         cfg = self.cfg
@@ -767,9 +784,18 @@ class LifeSim:
         )
         if not save and not checkpointing and plan is None and not guard:
             # The default fast path, unchanged: one advance covers the
-            # whole budget, no host round trips inside it.
+            # whole budget, no host round trips inside it. The span (a
+            # shared no-op singleton when MOMP_TRACE is unset) anchors on
+            # the board so its duration covers execution, not dispatch.
             if cfg.steps > self.step_count:
-                self.step(cfg.steps - self.step_count)
+                with trace.span(
+                    "life.advance",
+                    steps=cfg.steps - self.step_count,
+                    impl=self.impl,
+                    layout=self.layout,
+                ) as sp:
+                    self.step(cfg.steps - self.step_count)
+                    sp.anchor(self.board)
             return self.collect()
         i = self.step_count
         with preempt.flush_on_signal(
@@ -791,10 +817,19 @@ class LifeSim:
                     time.sleep(plan.delay_s)
                 # Advance to the next boundary in one jit call.
                 next_stop = self._next_stop(i, save)
-                if guard:
-                    self._guarded_step(next_stop - i)
-                else:
-                    self.step(next_stop - i)
+                with trace.span(
+                    "life.segment",
+                    start=i,
+                    stop=next_stop,
+                    impl=self.impl,
+                    layout=self.layout,
+                    guarded=guard,
+                ) as sp:
+                    if guard:
+                        self._guarded_step(next_stop - i)
+                    else:
+                        self.step(next_stop - i)
+                    sp.anchor(self.board)
                 prev_i, i = i, next_stop
                 if (plan is not None and plan.preempt_step is not None
                         and not plan.preempt_fired

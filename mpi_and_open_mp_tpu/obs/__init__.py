@@ -1,0 +1,29 @@
+"""Observability: span tracing, a metrics registry, and trace reporting.
+
+The reference repo's entire observability story is one ``MPI_Wtime``
+bracket printed from rank 0 plus a hand-grown ``times.txt``
+(``/root/reference/3-life/life_mpi.c:50,64-67``). This package is its
+TPU-native replacement, zero-dependency (stdlib only) and zero-overhead
+when off:
+
+``trace``
+    Nestable spans with a context-manager API, monotonic durations
+    (``utils.timing.Timer`` is the clock), process/host ids, and a JSONL
+    sink selected by ``MOMP_TRACE=path``. Spans close through
+    ``anchor_sync`` so async device work is attributed to the span that
+    dispatched it. When ``MOMP_TRACE`` is unset every call degenerates to
+    one env lookup returning a shared no-op span — the chaos layer's
+    ``is None`` discipline.
+``metrics``
+    Process-wide counters/gauges/histograms: jit retraces per function,
+    ring hops per engine, traced halo exchanges, guard validations and
+    ``:recovered`` ladder falls, checkpoint bytes/durations. On by
+    default (host-side dict ops); ``MOMP_METRICS=0`` no-ops every
+    recorder. ``bench.py`` publishes ``snapshot()`` on its JSON line.
+``report``
+    Pure-host analysis of a trace file: per-phase breakdown, α+βn fit
+    over ring-hop transfer spans, recovery/retrace summary. CLI form:
+    ``analysis/trace_report.py``.
+"""
+
+from mpi_and_open_mp_tpu.obs import metrics, trace  # noqa: F401

@@ -1,0 +1,194 @@
+"""Trace-file analysis: phase breakdown, hop fit, recovery/retrace summary.
+
+Consumes the JSONL a ``MOMP_TRACE`` sink produced (``obs.trace`` schema)
+and reduces it to the three questions the observability layer exists to
+answer:
+
+* **Where did the wall clock go?** — per-span-name totals against the
+  wall covered by root spans (``phases``).
+* **What did the ring do?** — traced attention steps, per-hop span
+  counts (the ``2*(p-1)`` contract), engines seen, and an α+βn fit over
+  the ``ring.hop.transfer`` (bytes, µs) rows whenever the trace carries
+  at least two distinct transfer sizes — the same ``fabric.fit_alpha_beta``
+  model the pingpong probe uses, now fed by production hops.
+* **What went wrong and what got rebuilt?** — recovery events by stamp,
+  and the ``jit.retrace{fn=...}`` counters from the last ``metrics``
+  snapshot event in the stream.
+
+Kept import-light on purpose: ``fabric`` (which pulls in jax) loads only
+when a hop fit is actually computable, so ``trace_report.py --json`` on a
+ring-free trace never touches the accelerator stack.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def load(path: str) -> list[dict]:
+    """Parse one record per non-blank line; raise ``ValueError`` naming
+    the first malformed line (a truncated tail from a killed process is
+    a real signal, not something to paper over)."""
+    records = []
+    with open(path) as fd:
+        for lineno, line in enumerate(fd, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: not a JSON record ({e.msg})") from e
+            if not isinstance(rec, dict) or "kind" not in rec:
+                raise ValueError(
+                    f"{path}:{lineno}: record without a 'kind' field")
+            records.append(rec)
+    return records
+
+
+def _spans(records: list[dict], name: str | None = None) -> list[dict]:
+    return [r for r in records if r.get("kind") == "span"
+            and (name is None or r.get("name") == name)]
+
+
+def _phase_breakdown(records: list[dict]) -> dict:
+    spans = _spans(records)
+    # Wall = time under root spans only; nested spans re-count their
+    # parents' time, so summing every span would exceed 100%.
+    wall = sum(s.get("dur", 0.0) for s in spans if s.get("parent") is None)
+    phases: dict[str, dict] = {}
+    for s in spans:
+        ph = phases.setdefault(
+            s["name"], {"count": 0, "total_s": 0.0, "errors": 0})
+        ph["count"] += 1
+        ph["total_s"] += s.get("dur", 0.0)
+        if "error" in s:
+            ph["errors"] += 1
+    for ph in phases.values():
+        ph["total_s"] = round(ph["total_s"], 6)
+        ph["mean_s"] = round(ph["total_s"] / ph["count"], 6)
+        ph["share"] = round(ph["total_s"] / wall, 4) if wall > 0 else None
+    return {"wall_s": round(wall, 6), "by_name": phases}
+
+
+def _hop_fit(transfers: list[dict]) -> dict | None:
+    """α+βn over (bytes, mean µs) of the transfer spans — needs two
+    distinct sizes or the slope is unconstrained."""
+    by_size: dict[int, list[float]] = {}
+    for s in transfers:
+        b = (s.get("attrs") or {}).get("bytes")
+        if isinstance(b, (int, float)) and b > 0:
+            by_size.setdefault(int(b), []).append(s.get("dur", 0.0) * 1e6)
+    if len(by_size) < 2:
+        return None
+    from mpi_and_open_mp_tpu.parallel import fabric
+
+    rows = [(b, sum(us) / len(us)) for b, us in sorted(by_size.items())]
+    return fabric.fit_alpha_beta(rows).as_json()
+
+
+def _attention(records: list[dict]) -> dict:
+    steps = [s for s in _spans(records, "ring_attention")
+             if (s.get("attrs") or {}).get("traced_dispatch")]
+    whole = [s for s in _spans(records, "ring_attention")
+             if not (s.get("attrs") or {}).get("traced_dispatch")]
+    transfers = _spans(records, "ring.hop.transfer")
+    folds = _spans(records, "ring.hop.fold")
+    engines = sorted({(s.get("attrs") or {}).get("engine", "?")
+                      for s in folds + steps + whole})
+    hop_spans = len(transfers) + len(folds)
+    return {
+        "traced_steps": len(steps),
+        "whole_call_spans": len(whole),
+        "hop_spans": hop_spans,
+        "transfer_spans": len(transfers),
+        "fold_spans": len(folds),
+        "hop_spans_per_step": (round(hop_spans / len(steps), 3)
+                               if steps else None),
+        "engines": engines,
+        "hop_fit": _hop_fit(transfers),
+    }
+
+
+def _recoveries(records: list[dict]) -> dict:
+    by_stamp: dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "recovery":
+            stamp = (r.get("attrs") or {}).get("stamp", "?")
+            by_stamp[stamp] = by_stamp.get(stamp, 0) + 1
+    return {"total": sum(by_stamp.values()), "by_stamp": by_stamp}
+
+
+def _retraces(records: list[dict]) -> dict:
+    """``jit.retrace{fn=...}`` counters from the LAST ``metrics``
+    snapshot event — the registry is cumulative, so the last snapshot
+    supersedes every earlier one."""
+    snap = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "metrics":
+            snap = (r.get("attrs") or {}).get("snapshot")
+    if not isinstance(snap, dict):
+        return {}
+    out = {}
+    for key, val in snap.get("counters", {}).items():
+        if key.startswith("jit.retrace{"):
+            fn = key[len("jit.retrace{"):-1].removeprefix("fn=")
+            out[fn] = val
+    return out
+
+
+def report_dict(records: list[dict]) -> dict:
+    """The full report as JSON-ready data (``trace_report.py --json``)."""
+    return {
+        "records": len(records),
+        "phases": _phase_breakdown(records),
+        "attention": _attention(records),
+        "recoveries": _recoveries(records),
+        "retraces": _retraces(records),
+    }
+
+
+def render(rep: dict) -> str:
+    """Text tables of :func:`report_dict` output for terminal reading."""
+    lines = []
+    ph = rep["phases"]
+    lines.append(f"trace: {rep['records']} records, "
+                 f"wall {ph['wall_s']:.3f}s under root spans")
+    lines.append("")
+    lines.append(f"{'span':<24}{'count':>7}{'total s':>12}"
+                 f"{'mean s':>12}{'share':>8}")
+    for name, row in sorted(ph["by_name"].items(),
+                            key=lambda kv: -kv[1]["total_s"]):
+        share = f"{row['share']:.1%}" if row["share"] is not None else "-"
+        err = f"  ({row['errors']} errors)" if row["errors"] else ""
+        lines.append(f"{name:<24}{row['count']:>7}{row['total_s']:>12.4f}"
+                     f"{row['mean_s']:>12.6f}{share:>8}{err}")
+    att = rep["attention"]
+    if att["traced_steps"] or att["whole_call_spans"]:
+        lines.append("")
+        lines.append(
+            f"attention: {att['traced_steps']} traced steps, "
+            f"{att['hop_spans']} hop spans "
+            f"({att['transfer_spans']} transfer + {att['fold_spans']} fold"
+            + (f", {att['hop_spans_per_step']}/step"
+               if att["hop_spans_per_step"] is not None else "")
+            + f"), engines: {', '.join(att['engines'])}")
+        if att["hop_fit"]:
+            f = att["hop_fit"]
+            bw = (f"{f['bandwidth_mb_s']}MB/s" if f["identifiable"]
+                  else "unidentifiable(beta<=0)")
+            lines.append(f"hop fit: alpha={f['alpha_us']}us bandwidth={bw} "
+                         f"r2={f['r2']}")
+    rec = rep["recoveries"]
+    if rec["total"]:
+        lines.append("")
+        lines.append(f"recoveries: {rec['total']}")
+        for stamp, n in sorted(rec["by_stamp"].items()):
+            lines.append(f"  {stamp}: {n}")
+    if rep["retraces"]:
+        lines.append("")
+        lines.append("jit retraces (from last metrics snapshot):")
+        for fn, n in sorted(rep["retraces"].items()):
+            lines.append(f"  {fn}: {int(n)}")
+    return "\n".join(lines)

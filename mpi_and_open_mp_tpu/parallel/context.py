@@ -1449,6 +1449,154 @@ def _ring_backward_hopflash(axis: str, causal: bool, p: int, res, do,
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Traced hop-by-hop ring dispatch (obs.trace): per-hop telemetry.
+#
+# Per-hop ring spans are impossible from inside the compiled ring: the
+# p-1 hops live in one `fori_loop` inside one `shard_map` program — the
+# host sees a single dispatch, so there is nothing to bracket. When a
+# trace sink is armed (`MOMP_TRACE`, and no chaos plan / guards in the
+# way), `ring_attention` therefore re-plans the CONTIGUOUS forward as
+# p-1 host-level hop dispatches: each hop issues (1) one jitted
+# shard_map ppermute rotation of the K/V blocks — the `ring.hop.transfer`
+# span, anchored so the wire time is attributed — then (2) one jitted
+# fold of the arrived block into the running normalised (o, L) partial
+# via `_merge_partials` — the `ring.hop.fold` span, tagged with the same
+# engine stamp `ring_hop_engine_for` reports (the fold runs the real
+# per-hop engine: `_hop_flash_block` whenever `_ring_hop_plan` grants a
+# plan, else a `_block_update`-based jnp partial). Exactly 2*(p-1)
+# `ring.hop.*` spans per attention step; the hop-0 resident diagonal is
+# a separate `ring.fold.resident` span (it moves no bytes). The result
+# is parity-exact with the fused ring — `_merge_partials` is the exact
+# associative combine — but each hop pays a host round trip, so this
+# path exists for telemetry, never inside timing brackets. Causal zigzag
+# keeps the fused engine (its half-chunk hops don't decompose into
+# whole-block host folds) and gets a whole-call span instead.
+
+
+def _traced_hop_partial(qs, kb, vb, causal_blk: bool, plan):
+    """One hop's NORMALISED (o, L) partial on the planned engine — the
+    same quantity `_hop_flash_block` emits, computed per shard."""
+    if plan is not None:
+        _, blk, _, groups = plan
+        return _hop_flash_block(qs, kb, vb, causal_blk, blk, groups)
+    hq, nl, _ = qs.shape
+    if kb.shape[0] != hq:
+        kb, vb = _repeat_heads(kb, vb, hq // kb.shape[0])
+    rows = jnp.arange(nl)
+    o0 = jnp.zeros(qs.shape, jnp.float32)
+    m0 = jnp.full((hq, nl), _NEG, jnp.float32)
+    l0 = jnp.zeros((hq, nl), jnp.float32)
+    o, m, l = _block_update(qs.astype(jnp.float32), kb, vb,
+                            rows, rows, None, causal_blk, o0, m0, l0)
+    l = jnp.maximum(l, 1e-37)
+    return o / l[..., None], m + jnp.log(l)
+
+
+def _traced_L_spec(axis: str) -> P:
+    return P(None, axis)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _traced_rotate_jit(kb, vb, *, mesh: Mesh, axis: str):
+    """One K/V ring rotation — the traced ring's transfer step."""
+
+    def body(kb, vb):
+        p = axis_size(axis)
+        perm = ring_perm(p, 1)
+        return lax.ppermute(kb, axis, perm), lax.ppermute(vb, axis, perm)
+
+    spec = _seq_spec(axis)
+    return mesh_lib.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec),
+        check_vma=False)(kb, vb)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "axis", "causal", "plan"))
+def _traced_fold0_jit(q, kb, vb, *, mesh: Mesh, axis: str, causal: bool,
+                      plan):
+    """Hop 0: the resident diagonal block's partial (the one hop whose
+    causal mask is the standard triangle in local coordinates)."""
+
+    def body(qs, kb, vb):
+        return _traced_hop_partial(qs, kb, vb, causal, plan)
+
+    spec = _seq_spec(axis)
+    return mesh_lib.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, _traced_L_spec(axis)), check_vma=False)(q, kb, vb)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "axis", "causal", "plan"))
+def _traced_fold_jit(o, L, q, kb, vb, j, *, mesh: Mesh, axis: str,
+                     causal: bool, plan):
+    """Fold the block that arrived after ``j >= 1`` rotations into the
+    running (o, L). ``j`` rides as data (one compile serves every hop).
+    After j rotations the block originated on ring position
+    ``(idx - j) % p`` — never the diagonal, so it is either fully
+    unmasked or (causal, src > idx) entirely in the future and skipped.
+    No collectives in here, so the skip `cond` is safe per device."""
+
+    def body(o, L, qs, kb, vb, j):
+        def take(state):
+            o2, L2 = _traced_hop_partial(qs, kb, vb, False, plan)
+            return _merge_partials(state[0], state[1], o2, L2)
+
+        if not causal:
+            return take((o, L))
+        p = axis_size(axis)
+        idx = lax.axis_index(axis)
+        src = (idx - j) % p
+        return lax.cond(src < idx, take, lambda s: s, (o, L))
+
+    spec = _seq_spec(axis)
+    lsp = _traced_L_spec(axis)
+    return mesh_lib.shard_map(
+        body, mesh=mesh, in_specs=(spec, lsp, spec, spec, spec, P()),
+        out_specs=(spec, lsp), check_vma=False)(o, L, q, kb, vb, j)
+
+
+def _ring_attention_traced(q, k, v, *, mesh: Mesh, axis: str, causal: bool):
+    """Hop-by-hop instrumented contiguous ring forward (module comment
+    above). Operands arrive already device_put with the ring sharding."""
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+
+    p = mesh.shape[axis]
+    h, n, d = q.shape
+    nl = n // p
+    plan = _ring_hop_plan(
+        jax.ShapeDtypeStruct((h, nl, d), q.dtype),
+        jax.ShapeDtypeStruct((k.shape[0], nl, d), k.dtype),
+        jax.ShapeDtypeStruct((v.shape[0], nl, d), v.dtype),
+        causal, "contiguous")
+    engine = "jnp" if plan is None else _plan_stamp(plan)
+    hop_bytes = (k.nbytes + v.nbytes) // p  # per-device K/V block pair
+    with trace.span("ring_attention", devices=p, seq=n, heads=h,
+                    causal=causal, engine=engine,
+                    traced_dispatch=True) as sp:
+        with trace.span("ring.fold.resident", engine=engine) as rsp:
+            o, L = _traced_fold0_jit(q, k, v, mesh=mesh, axis=axis,
+                                     causal=causal, plan=plan)
+            rsp.anchor((o, L))
+        kb, vb = k, v
+        for j in range(1, p):
+            with trace.span("ring.hop.transfer", hop=j,
+                            bytes=hop_bytes) as tsp:
+                kb, vb = _traced_rotate_jit(kb, vb, mesh=mesh, axis=axis)
+                tsp.anchor((kb, vb))
+            with trace.span("ring.hop.fold", hop=j, engine=engine) as fsp:
+                o, L = _traced_fold_jit(o, L, q, kb, vb, jnp.int32(j),
+                                        mesh=mesh, axis=axis,
+                                        causal=causal, plan=plan)
+                fsp.anchor((o, L))
+        metrics.inc("ring.hops.fwd", p - 1, engine=engine)
+        metrics.inc("ring.steps.traced")
+        sp.anchor(o)
+    return o.astype(q.dtype)
+
+
 def ring_hop_engine_for(q, k, v, *, p: int | None = None,
                         causal: bool = True,
                         layout: str = "contiguous") -> str:
@@ -1855,6 +2003,12 @@ def _sharded_attention_jit(q, k, v, *, local_fn, mesh: Mesh, axis: str,
     ``None`` (one cache entry, zero overhead) whenever no plan is
     active."""
     del chaos_key
+    # Body runs only on a jit-cache miss — i.e. this IS the retrace
+    # counter (obs.metrics): every compile of the sharded attention
+    # scaffold lands one tick, cache hits land none.
+    from mpi_and_open_mp_tpu.obs import metrics as _metrics
+
+    _metrics.inc("jit.retrace", fn="sharded_attention")
     body = functools.partial(local_fn, axis=axis, causal=causal,
                              **local_kwargs)
     spec = _seq_spec(axis)
@@ -1917,6 +2071,25 @@ def ring_attention(
 
     plan = chaos.active_plan()
     if plan is None and not guards.guard_env():
+        from mpi_and_open_mp_tpu.obs import trace
+
+        if trace.hop_spans_active() and p > 1 and layout == "contiguous":
+            # Telemetry dispatch: hop-by-hop with per-hop spans (see the
+            # _ring_attention_traced block comment). Parity-exact, but a
+            # host round trip per hop — never on the untraced hot path.
+            return _ring_attention_traced(q, k, v, mesh=mesh, axis=axis,
+                                          causal=causal)
+        if trace.enabled():
+            # Shapes the hop-by-hop decomposition doesn't cover (1-device
+            # local, causal zigzag) or MOMP_TRACE_HOPS=0: whole-call span.
+            with trace.span("ring_attention", devices=p, seq=q.shape[1],
+                            layout=layout, causal=causal,
+                            engine=ring_hop_engine_for(
+                                q, k, v, p=p, causal=causal,
+                                layout=layout)) as sp:
+                out = dispatch()
+                sp.anchor(out)
+            return out
         # The production hot path: one env check, no validator (a finite
         # check is a full host fetch — see robust.guards module docs).
         return dispatch()
@@ -1936,11 +2109,18 @@ def ring_attention(
         with chaos.suppressed(), _ring_hop_pinned(False):
             return dispatch(("ring", "recover"))
 
-    out, stamp, _notes = guards.with_fallback(
-        [("hop", primary), ("jnp", jnp_fold_oracle)],
-        validator=guards.all_finite)
-    if stamp.endswith(":recovered"):
-        guards.record_recovery(f"ring_attention:{stamp}")
+    from mpi_and_open_mp_tpu.obs import trace
+
+    with trace.span("ring_attention", devices=p, seq=q.shape[1],
+                    layout=layout, causal=causal, guarded=True) as sp:
+        out, stamp, _notes = guards.with_fallback(
+            [("hop", primary), ("jnp", jnp_fold_oracle)],
+            validator=guards.all_finite)
+        sp.set(engine=stamp)
+        if stamp.endswith(":recovered"):
+            # The funnel emits the trace event — parented to this span.
+            guards.record_recovery(f"ring_attention:{stamp}")
+        sp.anchor(out)
     return out
 
 

@@ -128,6 +128,23 @@ class Fit(NamedTuple):
               else "unidentifiable(beta<=0)")
         return f"alpha={self.alpha_us:.3f}us bandwidth={bw} r2={self.r2:.3f}"
 
+    def as_json(self) -> dict:
+        """JSON-ready view for machine consumers (pingpong's fit line,
+        trace_report's hop fit). An unidentifiable fit must NOT emit the
+        internal ``inf`` sentinel — ``json.dumps`` would write bare
+        ``Infinity``, which strict parsers reject — so bandwidth/beta
+        become ``None``/``0.0`` there and the flag carries the verdict."""
+        # bandwidth is 1/β with β in µs/byte (bytes/µs ≡ MB/s numerically).
+        beta = (1.0 / self.bandwidth_mb_s) if self.identifiable else 0.0
+        return {
+            "alpha_us": round(float(self.alpha_us), 6),
+            "beta_us_per_byte": round(float(beta), 12),
+            "bandwidth_mb_s": (round(float(self.bandwidth_mb_s), 3)
+                               if self.identifiable else None),
+            "r2": round(float(self.r2), 6),
+            "identifiable": bool(self.identifiable),
+        }
+
 
 def fit_alpha_beta(rows: list[tuple[int, float]]) -> Fit:
     """Linear model t = α + β·n over the probe rows (times in µs).

@@ -61,6 +61,19 @@ def _chaos_ghost(ghost: jnp.ndarray) -> jnp.ndarray:
     return chaos.corrupt_ghost(ghost, spec)
 
 
+def _note_exchange(kind: str, axis_name: str) -> None:
+    """Trace-time metrics hook (``obs.metrics``): counts halo exchanges
+    TRACED, not executed — like :func:`_chaos_ghost`, these bodies run
+    only while XLA traces the program, so per-step execution counts are
+    not host-observable from in here. A traced-exchange count per
+    kind/axis is still the useful signal: it is the retrace-style "how
+    many distinct exchange programs were built" number, and zero of them
+    means the sharded path never engaged at all."""
+    from mpi_and_open_mp_tpu.obs import metrics
+
+    metrics.inc("halo.exchange.traced", kind=kind, axis=axis_name)
+
+
 def halo_pad_y(block: jnp.ndarray, axis_name: str = "y", depth: int = 1) -> jnp.ndarray:
     """Pad axis 0 of a shard with ghost rows from its ring neighbours.
 
@@ -68,6 +81,7 @@ def halo_pad_y(block: jnp.ndarray, axis_name: str = "y", depth: int = 1) -> jnp.
     top, ``depth`` rows from the next shard at the bottom. With a single
     shard on the axis this degenerates to a torus self-wrap.
     """
+    _note_exchange("y", axis_name)
     p = _axis_size(axis_name)
     # My top ghost rows are the *last* rows of my predecessor: everyone
     # sends their bottom edge forward around the ring.
@@ -83,6 +97,7 @@ def halo_pad_x(block: jnp.ndarray, axis_name: str = "x", depth: int = 1) -> jnp.
     The reference needed ``MPI_Type_vector`` strided datatypes for this
     (``4-life/life_mpi.c:106-109``); here it is a slice + ``ppermute``.
     """
+    _note_exchange("x", axis_name)
     p = _axis_size(axis_name)
     left = _chaos_ghost(
         lax.ppermute(block[:, -depth:], axis_name, ring_perm(p, 1)))
@@ -107,6 +122,7 @@ def packed_halo_y(
 
     if pad == 0:
         return halo_pad_y(e, axis_name, h)
+    _note_exchange("packed_y", axis_name)
     p = _axis_size(axis_name)
     s = h + 1 + pad // 32
     up = lax.ppermute(e[-s:], axis_name, ring_perm(p, 1))
@@ -141,6 +157,7 @@ def packed_halo_x(
     """
     if pad == 0:
         return halo_pad_x(block, axis_name, hx)
+    _note_exchange("packed_x", axis_name)
     p = _axis_size(axis_name)
     s = hx + pad
     left = lax.ppermute(block[:, -s:], axis_name, ring_perm(p, 1))

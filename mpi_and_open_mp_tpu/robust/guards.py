@@ -17,6 +17,7 @@ only when a chaos plan is active (``MOMP_CHAOS``) or explicitly via
 
 from __future__ import annotations
 
+import collections
 import os
 
 from mpi_and_open_mp_tpu.robust import chaos
@@ -42,6 +43,8 @@ def with_fallback(engines, validator=None, *, retries: int = 1):
     it, so provenance distinguishes a first-try pass from a self-healed
     one. Raises :class:`FallbackExhausted` when the chain runs dry.
     """
+    from mpi_and_open_mp_tpu.obs import metrics
+
     notes: list[str] = []
     clean = True
     for name, thunk in engines:
@@ -53,6 +56,7 @@ def with_fallback(engines, validator=None, *, retries: int = 1):
                 clean = False
                 continue
             if validator is not None:
+                metrics.inc("guard.validation", engine=name)
                 try:
                     ok = bool(validator(result))
                 except Exception as e:
@@ -60,6 +64,7 @@ def with_fallback(engines, validator=None, *, retries: int = 1):
                         f"{name} validator: {type(e).__name__}: {e}"[:160])
                     ok = False
                 if not ok:
+                    metrics.inc("guard.validation_failed", engine=name)
                     if not notes or not notes[-1].startswith(f"{name} "):
                         notes.append(f"{name} failed validation")
                     clean = False
@@ -88,17 +93,41 @@ def guards_active() -> bool:
     return (plan is not None and plan.guard) or guard_env()
 
 
-_RECOVERIES: list[str] = []
+# Recovery provenance lives in two places with distinct jobs: aggregate
+# COUNTS go to the metrics registry (``recovery{stamp=...}`` counters —
+# what bench's ``metrics`` sub-object and trace_report's summary read),
+# and the ORDERED recent stamps sit in this bounded ring buffer (what
+# bench's ``recovered`` list publishes). The cap keeps a pathological
+# re-fire loop from growing process memory without bound; 256 stamps is
+# far beyond anything a sane run produces, so the artifact view is
+# lossless in practice while the registry's counts stay exact always.
+RECOVERY_LOG_CAP = 256
+_RECOVERIES: collections.deque[str] = collections.deque(
+    maxlen=RECOVERY_LOG_CAP)
 
 
 def record_recovery(stamp: str) -> None:
-    """Process-wide recovery provenance (``bench.py`` publishes it)."""
+    """The one funnel every recovery passes through: ring buffer +
+    metrics counter + trace event (``bench.py`` publishes the first two;
+    a ``MOMP_TRACE`` sink sees each recovery in stream order)."""
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+
     _RECOVERIES.append(stamp)
+    metrics.inc("recovery", stamp=stamp)
+    trace.event("recovery", stamp=stamp)
 
 
 def recovery_log() -> list[str]:
+    """The most recent recovery stamps, oldest first (capped at
+    :data:`RECOVERY_LOG_CAP`)."""
     return list(_RECOVERIES)
 
 
-def clear_recovery_log() -> None:
+def reset_recovery_log() -> None:
+    """Empty the ring buffer (tests; registry counters are untouched —
+    use ``obs.metrics.reset()`` for those)."""
     _RECOVERIES.clear()
+
+
+# Pre-obs name, kept working: existing tests and harness code call it.
+clear_recovery_log = reset_recovery_log

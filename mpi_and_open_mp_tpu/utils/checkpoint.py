@@ -56,23 +56,34 @@ def _board_crc(board) -> np.uint32:
 def save(path: str | os.PathLike, board: jax.Array, step: int) -> None:
     """Write ``{board, step, crc}`` as an Orbax checkpoint at ``path``,
     atomically (tmp sibling + rename — module docs)."""
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+    from mpi_and_open_mp_tpu.utils.timing import Timer
+
     path = os.path.abspath(os.fspath(path))
-    tmp = path + ".tmp"
-    # A crashed earlier save may have left a stale sibling; it was never
-    # authoritative.
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    _checkpointer().save(
-        tmp,
-        {"board": board, "step": np.int64(step), "crc": _board_crc(board)},
-        force=True,
-    )
-    # os.replace can't overwrite a non-empty dir: clear the old tree
-    # first. A kill in the gap loses only the OLD checkpoint (the new one
-    # sits complete at tmp); no window ever exposes a partial tree.
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.replace(tmp, path)
+    nbytes = int(getattr(board, "nbytes", 0))
+    with trace.span("checkpoint.save", step=int(step),
+                    bytes=nbytes, path=path), Timer() as t:
+        tmp = path + ".tmp"
+        # A crashed earlier save may have left a stale sibling; it was
+        # never authoritative.
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        _checkpointer().save(
+            tmp,
+            {"board": board, "step": np.int64(step),
+             "crc": _board_crc(board)},
+            force=True,
+        )
+        # os.replace can't overwrite a non-empty dir: clear the old tree
+        # first. A kill in the gap loses only the OLD checkpoint (the new
+        # one sits complete at tmp); no window ever exposes a partial
+        # tree.
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+    metrics.inc("checkpoint.saves")
+    metrics.inc("checkpoint.save.bytes", nbytes)
+    metrics.observe("checkpoint.save_seconds", t.elapsed)
 
 
 def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
@@ -82,15 +93,21 @@ def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
     restoring host-side keeps restore mesh-shape-agnostic. Raises
     ``ValueError`` on a missing/corrupt/partial tree or a CRC mismatch.
     """
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+    from mpi_and_open_mp_tpu.utils.timing import Timer
+
     path = os.path.abspath(os.fspath(path))
     if not os.path.isdir(path):
         raise ValueError(f"no checkpoint directory at {path}")
-    try:
-        tree = _checkpointer().restore(path)
-    except Exception as e:
-        raise ValueError(
-            f"corrupt or partial checkpoint at {path} "
-            f"({type(e).__name__}: {e})"[:400]) from e
+    with trace.span("checkpoint.restore", path=path), Timer() as t:
+        try:
+            tree = _checkpointer().restore(path)
+        except Exception as e:
+            raise ValueError(
+                f"corrupt or partial checkpoint at {path} "
+                f"({type(e).__name__}: {e})"[:400]) from e
+    metrics.inc("checkpoint.restores")
+    metrics.observe("checkpoint.restore_seconds", t.elapsed)
     if not isinstance(tree, dict) or "board" not in tree or "step" not in tree:
         raise ValueError(
             f"checkpoint at {path} is missing its board/step leaves "
@@ -100,6 +117,7 @@ def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
         raise ValueError(
             f"checkpoint board at {path} has rank {board.ndim}, want 2")
     board = board.astype(np.uint8)
+    metrics.inc("checkpoint.restore.bytes", int(board.nbytes))
     step = int(tree["step"])
     if step < 0:
         raise ValueError(f"checkpoint at {path} carries negative step {step}")

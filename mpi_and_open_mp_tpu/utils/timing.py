@@ -48,15 +48,29 @@ def anchor_sync(tree, fetch_all: bool = False) -> None:
 
 
 class Timer:
-    """Context manager measuring wall seconds; ``.elapsed`` after exit."""
+    """Context manager measuring wall seconds.
+
+    ``.elapsed`` reads the RUNNING total inside the ``with`` block (a live
+    ``perf_counter`` difference — mid-flight progress reads, span
+    heartbeats) and freezes at exit. This is the one wall-clock
+    implementation in the framework: the span tracer (``obs.trace``) uses
+    it as its clock, so spans and bench brackets can never disagree on
+    what a second is.
+    """
 
     def __enter__(self) -> "Timer":
         self.start = time.perf_counter()
-        self.elapsed = float("nan")
+        self._stopped: float | None = None
         return self
 
+    @property
+    def elapsed(self) -> float:
+        if self._stopped is None:
+            return time.perf_counter() - self.start
+        return self._stopped
+
     def __exit__(self, *exc) -> None:
-        self.elapsed = time.perf_counter() - self.start
+        self._stopped = time.perf_counter() - self.start
 
 
 def append_times_txt(path: str, seconds: float) -> None:

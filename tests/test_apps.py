@@ -1,5 +1,6 @@
 """CLI driver contracts: stdout formats, times.txt accumulation, VTK output."""
 
+import json
 import os
 
 import numpy as np
@@ -84,6 +85,11 @@ def test_pingpong_cli(tmp_path, capsys):
     captured = capsys.readouterr()
     lines = captured.out.strip().split("\n")
     assert lines[0] == "size,time"
-    assert len(lines) == 4  # header + sizes 1,10,100
+    # header + sizes 1,10,100 + the --fit JSON tail line
+    assert len(lines) == 5
+    fit = json.loads(lines[-1])
+    assert fit["metric"] == "pingpong_fit"
+    assert {"alpha_us", "beta_us_per_byte", "bandwidth_mb_s", "r2",
+            "identifiable"} <= fit.keys()
     assert "alpha=" in captured.err
     assert out_csv.exists()

@@ -54,3 +54,30 @@ def test_fit_alpha_beta_noise_dominated_flagged():
     assert fit.alpha_us == pytest.approx(
         sum(t for _, t in rows) / len(rows))
     assert fit.r2 < 0.9
+
+
+def test_fit_as_json_identifiable():
+    import json
+
+    # t = 5 + 0.01*n: alpha 5us, beta 0.01us/byte -> 100 MB/s.
+    rows = [(n, 5.0 + 0.01 * n) for n in (1, 10, 100, 1000, 10**4, 10**5)]
+    d = fabric.fit_alpha_beta(rows).as_json()
+    assert d["identifiable"] is True
+    assert d["alpha_us"] == pytest.approx(5.0, rel=1e-4)
+    assert d["bandwidth_mb_s"] == pytest.approx(100.0, rel=1e-4)
+    assert d["beta_us_per_byte"] == pytest.approx(0.01, rel=1e-4)
+    assert d["r2"] == pytest.approx(1.0, abs=1e-6)
+    # The whole point of as_json: strict-parser round trip, no Infinity.
+    assert json.loads(json.dumps(d)) == d
+
+
+def test_fit_as_json_unidentifiable_has_no_infinity():
+    import json
+
+    # Decreasing times with size: the fitted slope is strictly negative.
+    rows = [(1, 3200.0), (10, 3100.0), (100, 3000.0), (1000, 2900.0)]
+    d = fabric.fit_alpha_beta(rows).as_json()
+    assert d["identifiable"] is False
+    assert d["bandwidth_mb_s"] is None
+    assert d["beta_us_per_byte"] == 0.0
+    assert "Infinity" not in json.dumps(d)
