@@ -31,6 +31,12 @@ engines, not a timing of the ring itself. `--kv-heads` sweeps a GQA/MQA configur
 (TFLOP/s still counts the q-heads, which carry the compute).
 
 Usage: python analysis/sweep_attention.py [--out results/attention/attention_tpu.csv]
+
+``--update`` MERGES into an existing CSV instead of overwriting, keyed
+on seq — the r05 8k re-record replaces one row of the committed curve
+without re-running the rest of a chip-hour sweep. Rows written under an
+older (shorter) schema are padded with empty trailing fields to the
+current header, so the merged file stays rectangular.
 """
 
 from __future__ import annotations
@@ -46,6 +52,23 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 HEADS, DIM = 8, 128
+
+
+def merge_rows(out_path: str, header: str, new_rows: list[str]) -> list[str]:
+    """Header + data rows with ``new_rows`` merged over whatever
+    ``out_path`` already holds, keyed on seq (first column) and sorted;
+    rows from an older schema are padded to the header's width."""
+    ncol = header.count(",") + 1
+    merged: dict[int, str] = {}
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        for ln in lines[1:]:
+            ln += "," * max(0, ncol - 1 - ln.count(","))
+            merged[int(ln.split(",")[0])] = ln
+    for ln in new_rows:
+        merged[int(ln.split(",")[0])] = ln
+    return [header] + [merged[k] for k in sorted(merged)]
 
 
 def main(argv=None) -> int:
@@ -65,6 +88,9 @@ def main(argv=None) -> int:
                     "the dispatch picks (expand-to-Pallas within "
                     "budget, folded jnp otherwise) and the gate checks "
                     "that very configuration")
+    ap.add_argument("--update", action="store_true",
+                    help="merge rows into --out keyed on seq instead of "
+                    "overwriting — incremental chip windows / re-records")
     args = ap.parse_args(argv)
 
     hkv = HEADS if args.kv_heads is None else args.kv_heads
@@ -176,11 +202,15 @@ def main(argv=None) -> int:
 
     from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
 
-    rows = ["seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,"
-            "hop_engine,hop_engine_bwd"]
+    header = ("seq,fwd_sec,fwd_tflops,bwd_sec,bwd_tflops,differenced,engine,"
+              "hop_engine,hop_engine_bwd")
+    rows = [header]
 
     def flush() -> None:
-        write_csv_rows(args.out, rows)
+        if args.update:
+            write_csv_rows(args.out, merge_rows(args.out, header, rows[1:]))
+        else:
+            write_csv_rows(args.out, rows)
 
     for n in args.seqs:
         qkv = (jnp.asarray(rng.standard_normal((HEADS, n, DIM)),

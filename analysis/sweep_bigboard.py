@@ -15,6 +15,15 @@ difference), best-of-3 each. Emits a CSV:
     n,steps,path,steady_us_per_step,steady_gcups,differenced
 
 Usage:  python analysis/sweep_bigboard.py [--out results/life/bigboard_tpu.csv]
+
+``--update`` MERGES into an existing CSV instead of overwriting it —
+rows key on (n, path), so an incremental chip window (say the 20000/
+32768 board-curve extension queued for r05) adds its rows next to the
+committed ones instead of clobbering the curve. ``--ab N`` records a
+frame-vs-XLA A/B at one size: the natural dispatcher row plus an
+``xla-forced`` row driving ``bitlife.life_run_bits_xla`` directly on
+the same board, settling how much the padded-frame path actually buys
+at unaligned sizes.
 """
 
 from __future__ import annotations
@@ -29,25 +38,31 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure(n: int, steps: int) -> tuple[float, bool]:
-    """Steady seconds/step for an n x n board, and whether differenced."""
+def measure(n: int, steps: int, runner=None) -> tuple[float, bool]:
+    """Steady seconds/step for an n x n board, and whether differenced.
+
+    ``runner`` defaults to the native dispatcher ``life_run_vmem``; the
+    A/B mode passes a forced engine (same differencing discipline either
+    way — every runner here takes steps as a runtime scalar)."""
     import jax
 
     from mpi_and_open_mp_tpu.ops.pallas_life import life_run_vmem
     from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
+    if runner is None:
+        runner = life_run_vmem
     rng = np.random.default_rng(46)
     board = jax.device_put(
         (rng.random((n, n)) < 0.3).astype(np.uint8)
     )
-    anchor_sync(life_run_vmem(board, steps), fetch_all=True)  # compile
-    anchor_sync(life_run_vmem(board, 3 * steps), fetch_all=True)
+    anchor_sync(runner(board, steps), fetch_all=True)  # compile
+    anchor_sync(runner(board, 3 * steps), fetch_all=True)
 
     def timed(s: int) -> float:
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
-            anchor_sync(life_run_vmem(board, s), fetch_all=True)
+            anchor_sync(runner(board, s), fetch_all=True)
             best = min(best, time.perf_counter() - t0)
         return best
 
@@ -55,6 +70,23 @@ def measure(n: int, steps: int) -> tuple[float, bool]:
     if t3 > t1:
         return (t3 - t1) / (2 * steps), True
     return t1 / steps, False
+
+
+def merge_rows(out_path: str, header: str, new_rows: list[str]) -> list[str]:
+    """Header + data rows with ``new_rows`` merged over whatever
+    ``out_path`` already holds, keyed on (first column, path column) and
+    sorted numerically — the ``--update`` write set."""
+    merged: dict[tuple[int, str], str] = {}
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        for ln in lines[1:]:
+            parts = ln.split(",")
+            merged[(int(parts[0]), parts[2])] = ln
+    for ln in new_rows:
+        parts = ln.split(",")
+        merged[(int(parts[0]), parts[2])] = ln
+    return [header] + [merged[k] for k in sorted(merged)]
 
 
 def main(argv=None) -> int:
@@ -66,6 +98,14 @@ def main(argv=None) -> int:
         # (ny % 32 != 0) so it takes the padded-frame path; the rest fused.
         default=[500, 1024, 2048, 3072, 4096, 8192, 10000, 16384],
     )
+    ap.add_argument("--ab", type=int, default=None, metavar="N",
+                    help="A/B one size instead of the curve: the natural "
+                    "dispatcher row plus an xla-forced row on the same "
+                    "board (pair with --update to land both next to the "
+                    "committed curve)")
+    ap.add_argument("--update", action="store_true",
+                    help="merge rows into --out keyed on (n, path) instead "
+                    "of overwriting — incremental chip windows")
     args = ap.parse_args(argv)
 
     import jax
@@ -93,19 +133,37 @@ def main(argv=None) -> int:
 
     from mpi_and_open_mp_tpu.utils.timing import write_csv_rows
 
-    rows = ["n,steps,path,steady_us_per_step,steady_gcups,differenced"]
-    for n in args.sizes:
+    header = "n,steps,path,steady_us_per_step,steady_gcups,differenced"
+    new_rows: list[str] = []
+
+    def flush() -> None:
+        # After every point (crash-proof); --update folds the fresh rows
+        # over the committed CSV, plain mode rewrites it from scratch.
+        if args.update:
+            write_csv_rows(args.out, merge_rows(args.out, header, new_rows))
+        else:
+            write_csv_rows(args.out, [header] + new_rows)
+        print(new_rows[-1], flush=True)
+
+    def record(n: int, path_label: str, runner=None) -> None:
         # Aim ~0.5 s of steady compute per base run (floor 100 steps so
         # the fused paths cross several 128-step rounds).
         steps = max(100, min(2_000_000, int(7e11 / (n * n))))
-        sec, diff = measure(n, steps)
+        sec, diff = measure(n, steps, runner)
         gcups = n * n / sec / 1e9
-        rows.append(
-            f"{n},{steps},{native_path((n, n))},"
-            f"{sec * 1e6:.3f},{gcups:.1f},{int(diff)}"
+        new_rows.append(
+            f"{n},{steps},{path_label},{sec * 1e6:.3f},{gcups:.1f},{int(diff)}"
         )
-        write_csv_rows(args.out, rows)  # after every point (crash-proof)
-        print(rows[-1], flush=True)
+        flush()
+
+    if args.ab is not None:
+        from mpi_and_open_mp_tpu.ops import bitlife
+
+        record(args.ab, native_path((args.ab, args.ab)))
+        record(args.ab, "xla-forced", runner=bitlife.life_run_bits_xla)
+    else:
+        for n in args.sizes:
+            record(n, native_path((n, n)))
 
     print(f"wrote {args.out}", file=sys.stderr)
     return 0

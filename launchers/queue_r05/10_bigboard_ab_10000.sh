@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# r05 queued increment (results/README.md outage note): frame-vs-XLA A/B
+# at the unaligned 10000^2 board — the natural (padded-frame) dispatcher
+# row plus an xla-forced row, merged next to the committed board curve.
+# Drained by launchers/tpu_queue_loop.sh; one chip process, exits nonzero
+# on any failure so the loop keeps it queued.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python analysis/sweep_bigboard.py --ab 10000 --update \
+  --out results/life/bigboard_tpu.csv

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# r05 queued increment (results/README.md outage note): extend the
+# committed board curve with the 20000^2 and 32768^2 rows (both beyond
+# the largest recorded size; 20000 unaligned -> frame path, 32768
+# aligned -> fused). --update merges the new rows into the existing CSV.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python analysis/sweep_bigboard.py --sizes 20000 32768 --update \
+  --out results/life/bigboard_tpu.csv

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# r05 queued increment (results/README.md outage note): re-record the
+# 8k GQA row (kv-heads=2) — the committed row predates the per-hop ring
+# engine stamps, so the re-record also lands hop_engine/hop_engine_bwd
+# provenance. --update replaces just the seq=8192 row of the GQA CSV.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python analysis/sweep_attention.py --seqs 8192 --kv-heads 2 --update \
+  --out results/attention/attention_gqa_tpu.csv

@@ -11,6 +11,11 @@
 #              order; each moves to QUEUE_DIR/done/ on success. A failed
 #              job stays queued and the loop re-probes before retrying.
 #              The loop exits when no numbered jobs remain.
+#              launchers/queue_r05/ holds the queued-but-unrecorded r05
+#              increments (frame-vs-XLA A/B, 20000/32768 board-curve
+#              rows, 8k GQA re-record — see results/README.md); one pass
+#              of this loop over that directory drains them all when a
+#              chip window opens.
 #   LOG        append-only log (default /tmp/tpu_queue.log).
 #
 # Env knobs (tests stub the probe; operators rarely need these):

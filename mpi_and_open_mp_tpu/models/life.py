@@ -45,6 +45,16 @@ elsewhere; sharded layouts pick ``bitfused`` on TPU whenever the
 planner covers the board/mesh geometry, else ``halo`` when shapes
 divide, else ``roll``.
 
+A STACKED ``(B, ny, nx)`` ``initial_board`` puts the sim in batched
+mode (serial layout only): all B independent boards advance in ONE
+device dispatch through the batched native engines
+(``ops.pallas_life.life_run_vmem_batch``; ``impl="roll"`` vmaps the
+unpacked step instead), ``collect()`` returns the stack, and the
+honesty gate (``debug_check``/guards) checks EVERY board against the
+NumPy oracle individually. The serve-layer micro-batcher
+(``mpi_and_open_mp_tpu.serve``) is the request-collecting front door
+over the same engines.
+
 The run loop preserves the reference's ordering (``3-life/life_mpi.c:51-62``):
 at step ``i``, save a snapshot when ``i % save_steps == 0`` (i.e. *before*
 stepping), then advance one step. Collect-to-host is ``jax.device_get`` of
@@ -118,6 +128,13 @@ def _ceil_to(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def _oracle_step(board: np.ndarray) -> np.ndarray:
+    """One NumPy-oracle step; a (B, ny, nx) stack steps per board."""
+    if board.ndim == 3:
+        return np.stack([life_ops.life_step_numpy(b) for b in board])
+    return life_ops.life_step_numpy(board)
+
+
 def _note_retrace(fn: str) -> None:
     """Retrace accounting (``obs.metrics``): called from INSIDE jitted
     ``advance`` bodies, which only execute on a jit-cache miss — so the
@@ -164,6 +181,31 @@ class LifeSim:
             raise ValueError(f"layout must be one of {LAYOUTS}, got {layout!r}")
         if impl not in IMPLS:
             raise ValueError(f"impl must be one of {IMPLS}, got {impl!r}")
+        # Batched mode: a STACKED (B, ny, nx) initial board advances all B
+        # independent boards per dispatch through the batched native
+        # engines (ops.pallas_life.life_run_vmem_batch) — the model-layer
+        # face of the serve-layer micro-batching. Serial layout only (a
+        # batch of sharded boards is the serve layer's bucketing problem,
+        # not one mesh program), and no VTK/checkpoint channels (both
+        # serialise ONE board; batched runs are throughput runs).
+        self.batch: int | None = None
+        if initial_board is not None and np.asarray(initial_board).ndim == 3:
+            if layout != "serial":
+                raise ValueError(
+                    "stacked (B, ny, nx) boards need layout='serial'; "
+                    "sharded layouts advance one board per mesh program"
+                )
+            if impl in ("halo", "bitfused"):
+                raise ValueError(
+                    f"impl={impl!r} has no batched form; use 'auto', "
+                    "'pallas' (batched native dispatch) or 'roll'"
+                )
+            if outdir is not None or checkpoint_dir is not None:
+                raise ValueError(
+                    "batched runs have no snapshot/checkpoint channels "
+                    "(both serialise one board); drop outdir/checkpoint_dir"
+                )
+            self.batch = int(np.asarray(initial_board).shape[0])
         self.cfg = cfg
         self.layout = layout
         self.mesh = mesh if mesh is not None else _default_mesh(layout)
@@ -189,7 +231,13 @@ class LifeSim:
         )
         if impl == "auto":
             on_tpu = jax.default_backend() == "tpu"
-            if layout == "serial":
+            if self.batch is not None:
+                # The batched dispatcher compiles on EVERY backend (off-TPU
+                # it routes to the vmapped packed-XLA loop, never interpret
+                # mode — ops.pallas_life.native_path_batch), so batched
+                # auto is always the native dispatch.
+                impl = "pallas"
+            elif layout == "serial":
                 # Pallas only where it compiles natively; elsewhere it would
                 # run in interpret mode, orders of magnitude slower.
                 impl = "pallas" if on_tpu else "roll"
@@ -257,13 +305,17 @@ class LifeSim:
             self.padded_shape = (_ceil_to(cfg.ny, py), _ceil_to(cfg.nx, px))
         if initial_board is not None:
             board = np.asarray(initial_board, dtype=np.uint8)
-            if board.shape != cfg.shape:
+            expect = (
+                (self.batch, *cfg.shape) if self.batch is not None
+                else cfg.shape
+            )
+            if board.shape != expect:
                 raise ValueError(
-                    f"initial_board {board.shape} != cfg board {cfg.shape}"
+                    f"initial_board {board.shape} != expected {expect}"
                 )
         else:
             board = cfg.board()
-        if self.padded_shape != cfg.shape:
+        if self.batch is None and self.padded_shape != cfg.shape:
             full = np.zeros(self.padded_shape, dtype=board.dtype)
             full[: cfg.ny, : cfg.nx] = board
             board = full
@@ -298,6 +350,9 @@ class LifeSim:
 
     def _build_advance(self) -> Callable[[jnp.ndarray, int], jnp.ndarray]:
         """Return ``advance(board, n)`` running ``n`` steps, jit-cached on ``n``."""
+        if self.batch is not None:
+            return self._build_batched_advance()
+
         if self.impl == "bitfused":
             return self._build_bitfused_advance()
 
@@ -363,6 +418,36 @@ class LifeSim:
                     smapped_cache[rem] = make_smapped(rem)
                 board = smapped_cache[rem](board)
             return board
+
+        return advance
+
+    def _build_batched_advance(self) -> Callable:
+        """Stacked-board steppers: all B boards advance in ONE dispatch.
+
+        ``impl="pallas"`` is the batched native dispatch
+        (``ops.pallas_life.life_run_vmem_batch`` — runtime-scalar step
+        count, one compiled program per stack shape on every backend);
+        ``impl="roll"`` is the unpacked roll step vmapped over the stack
+        (jit-cached per static ``n``, like the single-board roll).
+        """
+        if self.impl == "pallas":
+            from mpi_and_open_mp_tpu.ops import pallas_life
+
+            self.plan_note = "batch:" + pallas_life.native_path_batch(
+                (self.batch, *self.cfg.shape),
+                on_tpu=jax.default_backend() == "tpu",
+            )
+
+            def advance(board, n):
+                return pallas_life.life_run_vmem_batch(board, n)
+
+            return advance
+
+        @functools.partial(jax.jit, static_argnums=1)
+        def advance(board, n):
+            _note_retrace("life_advance_roll_batch")
+            step = jax.vmap(life_ops.life_step_roll)
+            return lax.fori_loop(0, n, lambda _, b: step(b), board)
 
         return advance
 
@@ -583,9 +668,21 @@ class LifeSim:
             return "non-binary cells on the board"
         after_impl = np.asarray(
             jax.device_get(self._advance(self.board, 1)), dtype=np.uint8
-        )[: self.cfg.ny, : self.cfg.nx]
-        expect = life_ops.life_step_numpy(before)
+        )[..., : self.cfg.ny, : self.cfg.nx]
+        expect = _oracle_step(before)
         if not np.array_equal(after_impl, expect):
+            if after_impl.ndim == 3:
+                # PER-BOARD honesty: name every diverging board of the
+                # stack, not just "the batch diverged".
+                bad = [
+                    f"board {b}: {int((after_impl[b] != expect[b]).sum())}"
+                    for b in range(after_impl.shape[0])
+                    if not np.array_equal(after_impl[b], expect[b])
+                ]
+                return (
+                    f"cells diverge from the oracle after one "
+                    f"{self.impl}/{self.layout} step ({'; '.join(bad)})"
+                )
             diff = int((after_impl != expect).sum())
             return (
                 f"{diff} cells diverge from the oracle after one "
@@ -600,7 +697,7 @@ class LifeSim:
         probe, probe_expect = self._probe_case()
         after_probe = np.asarray(
             jax.device_get(self._advance(probe, 1)), dtype=np.uint8
-        )[: self.cfg.ny, : self.cfg.nx]
+        )[..., : self.cfg.ny, : self.cfg.nx]
         if not np.array_equal(after_probe, probe_expect):
             diff = int((after_probe != probe_expect).sum())
             return (
@@ -613,13 +710,22 @@ class LifeSim:
         """Cached ``(device_board, oracle_next)`` for the fixed-probe leg of
         ``_consistency_violation`` — placed exactly like the live board."""
         if self._probe is None:
+            shape = (self.cfg.ny, self.cfg.nx)
+            if self.batch is not None:
+                # B DISTINCT dense boards (one rng stream): a fault that
+                # corrupts only some stack positions must still perturb
+                # the board that sits there.
+                shape = (self.batch, *shape)
             host = np.random.default_rng(0xC0FFEE).integers(
-                0, 2, (self.cfg.ny, self.cfg.nx), dtype=np.uint8)
-            full = np.zeros(self.padded_shape, dtype=np.uint8)
-            full[: self.cfg.ny, : self.cfg.nx] = host
+                0, 2, shape, dtype=np.uint8)
+            if self.batch is None and self.padded_shape != host.shape:
+                full = np.zeros(self.padded_shape, dtype=np.uint8)
+                full[: self.cfg.ny, : self.cfg.nx] = host
+            else:
+                full = host
             b = jnp.asarray(full, dtype=self.dtype)
             b = jax.device_put(b, self.sharding) if self.sharding else b
-            self._probe = (b, life_ops.life_step_numpy(host))
+            self._probe = (b, _oracle_step(host))
         return self._probe
 
     def debug_check(self) -> None:
@@ -641,7 +747,7 @@ class LifeSim:
         """Install a host board as the live state (pad + device_put), the
         same placement the constructor performs."""
         board = np.asarray(board, dtype=np.uint8)
-        if self.padded_shape != board.shape:
+        if self.batch is None and self.padded_shape != board.shape:
             full = np.zeros(self.padded_shape, dtype=np.uint8)
             full[: self.cfg.ny, : self.cfg.nx] = board
             board = full
@@ -686,9 +792,9 @@ class LifeSim:
             guards.record_recovery(stamp)
             return
         board = np.asarray(jax.device_get(prev_board), dtype=np.uint8)[
-            : self.cfg.ny, : self.cfg.nx]
+            ..., : self.cfg.ny, : self.cfg.nx]
         for _ in range(n):
-            board = life_ops.life_step_numpy(board)
+            board = _oracle_step(board)
         self._set_board(board, prev_step + n)
         stamp = "life_step:numpy-oracle:recovered"
         self.recoveries.append(f"{stamp} ({why}; then {still})")
@@ -728,7 +834,9 @@ class LifeSim:
                 multihost_utils.process_allgather(self.board, tiled=True),
                 dtype=np.uint8,
             )
-        return full[: self.cfg.ny, : self.cfg.nx]
+        # Ellipsis crop: batched boards are (B, ny, nx), the crop applies
+        # to the trailing board axes either way.
+        return full[..., : self.cfg.ny, : self.cfg.nx]
 
     def save_snapshot(self) -> str:
         assert self.outdir is not None, "LifeSim(outdir=...) required to save"

@@ -63,6 +63,18 @@ def fits_vmem_packed(shape: tuple[int, int]) -> bool:
     return n_words(ny) * nxp * 4 <= _PACKED_VMEM_LIMIT
 
 
+def fits_vmem_packed_batch(shape: tuple[int, int, int]) -> bool:
+    """Whether a WHOLE (B, ny, nx) stack fits the packed VMEM budget at
+    once — the batched twin of :func:`fits_vmem_packed`, with the working
+    set scaled by B (the batched step holds the same ~11 live temporaries,
+    each now B boards deep). Stacks past this gate but whose single board
+    still fits stream through a grid over the batch axis instead (one
+    board resident per program — see :func:`life_run_vmem_bits_batch`)."""
+    b, ny, nx = shape
+    nxp = -(-nx // 128) * 128
+    return b * n_words(ny) * nxp * 4 <= _PACKED_VMEM_LIMIT
+
+
 def pack_board(board: jnp.ndarray) -> jnp.ndarray:
     """(ny, nx) 0/1 ints -> (n_words(ny), nx) uint32, offset-ghost layout.
 
@@ -901,3 +913,290 @@ def life_run_bits_xla(board: jnp.ndarray, n: int) -> jnp.ndarray:
     steps = jnp.asarray([n], dtype=jnp.int32)
     out = _run_bits_xla_jit(packed, steps, ny=ny)
     return unpack_board(out, ny).astype(dtype)
+
+
+# ------------------------------------------------- batched (B-board) engines
+#
+# Every engine above moves ONE board per device program, so a stream of
+# independent small boards is dispatch-bound (~70 ms host-device RTT per
+# request through the relay). The batched variants below thread a leading
+# batch axis through the same packed machinery — B boards advance in ONE
+# dispatch, bit-exact per board vs the serial engines:
+#
+# * the packed layout gains a leading axis, (B, n_words(ny), nx) — the
+#   word/lane axes stay the minor (sublane, lane) pair, so the VPU sees
+#   the identical tile shapes and the rolls/adders vectorise over B free;
+# * the VMEM kernel has a whole-stack-resident form (gated by
+#   :func:`fits_vmem_packed_batch` — B x the working set) and a
+#   grid-over-batch form (one board resident per program, the batch axis
+#   streamed by the Pallas pipeline) for stacks past that gate;
+# * the fused/frame big-board engines run the stack as a sequential
+#   ``lax.map`` inside one compiled program: big boards are compute-bound
+#   (grid parallelism buys nothing on one core), so one dispatch per
+#   stack is the whole win;
+# * the XLA packed loop vmaps — pure jnp, compiled on every backend.
+#
+# ``steps`` stays a runtime SMEM/scalar everywhere, so one compiled
+# program per (B, ny, nx) shape serves any step count — the property the
+# serve-layer shape bucketing (mpi_and_open_mp_tpu/serve/) relies on.
+# Each batched jit body ticks ``jit.retrace{fn=...}`` so the bucketing's
+# one-compile-per-bucket claim is observable, not asserted.
+
+
+def _note_retrace(fn: str) -> None:
+    """Tick ``jit.retrace{fn=...}`` — call INSIDE jitted bodies only (a
+    jit body runs on cache miss, so the count is compiles, not calls)."""
+    from mpi_and_open_mp_tpu.obs import metrics
+
+    metrics.inc("jit.retrace", fn=fn)
+
+
+def pack_boards(boards: jnp.ndarray) -> jnp.ndarray:
+    """(B, ny, nx) 0/1 ints -> (B, n_words(ny), nx) uint32 — the batched
+    offset-ghost pack (:func:`pack_board` vmapped over the stack)."""
+    return jax.vmap(pack_board)(boards)
+
+
+def unpack_boards(packed: jnp.ndarray, ny: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_boards`; returns (B, ny, nx) uint8."""
+    return jax.vmap(lambda p: unpack_board(p, ny))(packed)
+
+
+def _set_word_row_b(p: jnp.ndarray, w: int, row: jnp.ndarray) -> jnp.ndarray:
+    """Batched :func:`_set_word_row`: replace word-row ``w`` (axis 1) of a
+    (B, nw, nx) stack via concatenation — same ``.at[]`` avoidance."""
+    parts = []
+    if w > 0:
+        parts.append(p[:, :w, :])
+    parts.append(row)
+    if w + 1 < p.shape[1]:
+        parts.append(p[:, w + 1 :, :])
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else row
+
+
+def _refresh_ghosts_b(p: jnp.ndarray, ny: int) -> jnp.ndarray:
+    """Batched :func:`_refresh_ghosts`: the ghost word/bit indices are a
+    function of ``ny`` alone, so one static slice refreshes all B boards."""
+    w_lo, b_lo = divmod(ny, 32)
+    src = (p[:, w_lo : w_lo + 1, :] >> b_lo) & 1
+    p = _set_word_row_b(p, 0, (p[:, 0:1, :] & np.uint32(0xFFFFFFFE)) | src)
+    w_hi, b_hi = divmod(ny + 1, 32)
+    src = (p[:, 0:1, :] >> 1) & 1
+    new_hi = (
+        p[:, w_hi : w_hi + 1, :] & np.uint32(0xFFFFFFFF ^ (1 << b_hi))
+    ) | (src << b_hi)
+    return _set_word_row_b(p, w_hi, new_hi)
+
+
+def _roll_sub_b(p: jnp.ndarray, shift: int) -> jnp.ndarray:
+    nw = p.shape[1]
+    if nw == 1:
+        return p
+    return pltpu.roll(p, shift % nw, 1)
+
+
+def _lane_rolls_b(shape: tuple[int, int, int], nx: int):
+    """3-D twin of :func:`_lane_rolls`: lane axis 2, same wrap-column
+    patch when the stack is lane-padded past the board width."""
+    nxp = shape[2]
+    if nxp == nx:
+        return (
+            lambda x: pltpu.roll(x, 1, 2),
+            lambda x: pltpu.roll(x, nx - 1, 2),
+        )
+    lane = lax.broadcasted_iota(jnp.int32, shape, 2)
+
+    def roll_left(x):
+        return jnp.where(
+            lane == 0, x[:, :, nx - 1 : nx], pltpu.roll(x, 1, 2)
+        )
+
+    def roll_right(x):
+        return jnp.where(
+            lane == nx - 1, x[:, :, 0:1], pltpu.roll(x, nxp - 1, 2)
+        )
+
+    return roll_left, roll_right
+
+
+def bit_step_b(p: jnp.ndarray, ny: int, nx: int) -> jnp.ndarray:
+    """One Life step on a (B, nw, nx) packed stack — :func:`bit_step`
+    vectorised over the leading batch axis (the word/lane axes stay the
+    minor sublane/lane pair, so every roll and adder is the same VPU op,
+    B boards deep). Boards never interact: the y rolls are per-board
+    (axis 1) and the rule is positionwise."""
+    p = _refresh_ghosts_b(p, ny)
+    nw = p.shape[1]
+    dn = (p << 1) | (_roll_sub_b(p, 1) >> 31)
+    up = (p >> 1) | (_roll_sub_b(p, nw - 1) << 31)
+    return _carry_save_rule(p, up, dn, *_lane_rolls_b(p.shape, nx))
+
+
+def _vmem_bits_batch_kernel(steps_ref, p_ref, out_ref, *, ny: int, nx: int):
+    out_ref[:] = lax.fori_loop(
+        0, steps_ref[0], lambda _, p: bit_step_b(p, ny, nx), p_ref[:]
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ny", "nx", "interpret", "resident")
+)
+def _run_vmem_bits_batch_jit(
+    packed, steps, *, ny: int, nx: int, interpret: bool, resident: bool
+):
+    _note_retrace("life_batch_vmem")
+    b, nw, nxp = packed.shape
+    if resident:
+        # Whole stack VMEM-resident in one program: gated by
+        # fits_vmem_packed_batch (B x the per-board working set).
+        return pl.pallas_call(
+            functools.partial(_vmem_bits_batch_kernel, ny=ny, nx=nx),
+            out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(steps, packed)
+    # Grid over the batch axis: one board resident per program, the
+    # stack streamed through VMEM by the pipeline (per-board gate only).
+    return pl.pallas_call(
+        functools.partial(_vmem_bits_batch_kernel, ny=ny, nx=nx),
+        grid=(b,),
+        out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, nw, nxp), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nw, nxp), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(steps, packed)
+
+
+def life_run_vmem_bits_batch(
+    boards: jnp.ndarray, n: int, *, interpret: bool = False,
+    resident: bool | None = None,
+) -> jnp.ndarray:
+    """Advance B stacked boards ``n`` steps in ONE packed VMEM dispatch.
+
+    Same lane padding and runtime-scalar step count as
+    :func:`life_run_vmem_bits`. ``resident=None`` picks the whole-stack-
+    resident kernel when :func:`fits_vmem_packed_batch` allows and the
+    grid-over-batch form otherwise (tests pin either form explicitly);
+    callers must gate per-board shapes on :func:`fits_vmem_packed`.
+    """
+    b, ny, nx = boards.shape
+    dtype = boards.dtype
+    nxp = -(-nx // 128) * 128
+    if nxp != nx:
+        boards = jnp.pad(boards, ((0, 0), (0, 0), (0, nxp - nx)))
+    if resident is None:
+        resident = fits_vmem_packed_batch((b, ny, nx))
+    packed = pack_boards(boards)
+    steps = jnp.asarray([n], dtype=jnp.int32)
+    out = _run_vmem_bits_batch_jit(
+        packed, steps, ny=ny, nx=nx, interpret=interpret, resident=resident
+    )
+    return unpack_boards(out, ny)[:, :, :nx].astype(dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("ny",))
+def _run_bits_xla_batch_jit(packed, steps, *, ny: int):
+    _note_retrace("life_batch_xla")
+    nx = packed.shape[2]
+    step = jax.vmap(lambda q: bit_step_xla(q, ny, nx))
+    return lax.fori_loop(0, steps[0], lambda _, q: step(q), packed)
+
+
+def life_run_bits_xla_batch(boards: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Advance B stacked boards with the compiled-XLA packed loop — the
+    any-shape any-backend batched engine (:func:`bit_step_xla` vmapped;
+    one dispatch, runtime-scalar step count)."""
+    _, ny, _ = boards.shape
+    dtype = boards.dtype
+    packed = pack_boards(boards)
+    steps = jnp.asarray([n], dtype=jnp.int32)
+    out = _run_bits_xla_batch_jit(packed, steps, ny=ny)
+    return unpack_boards(out, ny).astype(dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_budget_bytes")
+)
+def _run_fused_bits_batch_jit(
+    packed, steps, *, interpret: bool,
+    tile_budget_bytes: int = _PACKED_VMEM_LIMIT,
+):
+    _note_retrace("life_batch_fused")
+    # Sequential scan over the stack, ONE compiled program: fused-regime
+    # boards are compute-bound on the core, so batching exists to
+    # amortise the dispatch, not to overlap boards. (A vmap would lean on
+    # pallas batching rules over the explicit-DMA scratch kernel; the
+    # scan keeps the proven single-board program byte-identical.)
+    return lax.map(
+        lambda p: _run_fused_bits_jit(
+            p, steps, interpret=interpret,
+            tile_budget_bytes=tile_budget_bytes,
+        ),
+        packed,
+    )
+
+
+def life_run_fused_bits_batch(
+    boards: jnp.ndarray, n: int, *, interpret: bool = False,
+    tile_budget_bytes: int = _PACKED_VMEM_LIMIT,
+) -> jnp.ndarray:
+    """Advance B stacked ALIGNED big boards via the multi-step-fused tiled
+    kernel, all boards in one dispatch (see the scan note in the jit)."""
+    dtype = boards.dtype
+    packed = jax.vmap(pack_board_exact)(boards)
+    steps = jnp.asarray([n], dtype=jnp.int32)
+    out = _run_fused_bits_batch_jit(
+        packed, steps, interpret=interpret,
+        tile_budget_bytes=tile_budget_bytes,
+    )
+    return jax.vmap(unpack_board_exact)(out).astype(dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ny", "nx", "interpret", "budget")
+)
+def _run_frame_bits_batch_jit(
+    packed, steps, *, ny: int, nx: int, interpret: bool, budget: int
+):
+    _note_retrace("life_batch_frame")
+    return lax.map(
+        lambda p: _run_frame_bits_jit(
+            p, steps, ny=ny, nx=nx, interpret=interpret, budget=budget
+        ),
+        packed,
+    )
+
+
+def life_run_frame_bits_batch(
+    boards: jnp.ndarray, n: int, *, interpret: bool = False,
+    budget: int = _PACKED_VMEM_LIMIT,
+) -> jnp.ndarray:
+    """Advance B stacked UNALIGNED big boards via the padded-torus frame,
+    all boards in one dispatch (same sequential-scan rationale as the
+    fused batch). Gate on ``plan_sharded_bits(shape, 1, 1, False, False)``.
+    """
+    b, ny, nx = boards.shape
+    plan = plan_sharded_bits((ny, nx), 1, 1, False, False, budget)
+    if plan is None:
+        raise ValueError(
+            f"no padded-frame plan for {(ny, nx)}; gate callers on "
+            "plan_sharded_bits()"
+        )
+    dtype = boards.dtype
+    frames = jnp.pad(
+        boards,
+        ((0, 0), (0, plan.frame[0] - ny), (0, plan.frame[1] - nx)),
+    )
+    packed = jax.vmap(pack_board_exact)(frames)
+    steps = jnp.asarray([n], dtype=jnp.int32)
+    out = _run_frame_bits_batch_jit(
+        packed, steps, ny=ny, nx=nx, interpret=interpret, budget=budget
+    )
+    return jax.vmap(unpack_board_exact)(out)[:, :ny, :nx].astype(dtype)

@@ -68,6 +68,60 @@ def native_path(shape: tuple[int, int], on_tpu: bool = True) -> str:
     return "xla"
 
 
+def native_path_batch(
+    shape: tuple[int, int, int], on_tpu: bool = True
+) -> str:
+    """Which batched native path :func:`life_run_vmem_batch` dispatches a
+    (B, ny, nx) stack to: ``"vmem"`` (whole stack VMEM-resident — the
+    gate is B x the per-board working set,
+    ``bitlife.fits_vmem_packed_batch``), ``"vmem-grid"`` (per-board
+    VMEM-resident, batch axis streamed by a Pallas grid), ``"fused"`` /
+    ``"frame"`` (big-board engines, the stack scanned inside one
+    program), or ``"xla"`` (vmapped compiled-XLA packed loop). The
+    single source of truth for the batched dispatch decision, as
+    :func:`native_path` is for single boards.
+
+    Off-TPU everything goes ``"xla"``: the single-board dispatcher runs
+    small boards through interpret-mode Pallas so tests cover the
+    production path, but a batch exists for THROUGHPUT — interpret mode
+    would grind B boards through a Python-level VM while the vmapped
+    packed loop compiles on every backend (the batched kernels get their
+    interpret-mode coverage from tests/test_batched.py directly)."""
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    b, ny, nx = shape
+    if on_tpu:
+        if bitlife.fits_vmem_packed_batch(shape):
+            return "vmem"
+        if bitlife.fits_vmem_packed((ny, nx)):
+            return "vmem-grid"
+        if bitlife.fused_bits_supported((ny, nx)):
+            return "fused"
+        if bitlife.plan_sharded_bits((ny, nx), 1, 1, False, False) is not None:
+            return "frame"
+    return "xla"
+
+
+def life_run_vmem_batch(boards: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Advance a (B, ny, nx) stack ``n`` steps in ONE dispatch, picking
+    the fastest batched native path (see :func:`native_path_batch`).
+    Bit-exact per board vs the serial engines; ``n`` is a runtime scalar
+    on every path, so one compiled program per stack shape serves any
+    step count — the contract the serve-layer bucketing depends on."""
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    path = native_path_batch(boards.shape, on_tpu=not _interpret())
+    if path in ("vmem", "vmem-grid"):
+        return bitlife.life_run_vmem_bits_batch(
+            boards, n, interpret=_interpret(), resident=(path == "vmem")
+        )
+    if path == "fused":
+        return bitlife.life_run_fused_bits_batch(boards, n)
+    if path == "frame":
+        return bitlife.life_run_frame_bits_batch(boards, n)
+    return bitlife.life_run_bits_xla_batch(boards, n)
+
+
 def life_run_vmem(board: jnp.ndarray, n: int) -> jnp.ndarray:
     """Advance ``n`` steps on one device, picking the fastest native path.
 

@@ -734,6 +734,28 @@ def tpu_flash_engine() -> str:
     return "pallas" if (_TPU_FLASH and on_tpu) else "jnp"
 
 
+def _fold_batch(x: jnp.ndarray) -> jnp.ndarray:
+    """Fold a (B, h, n, d) request batch into the head axis: (B*h, n, d).
+
+    Heads are UNSHARDED in every sequence-parallel spec here
+    (``_seq_spec`` keeps axis 0 replicated), so a request batch rides
+    the fold/kernel machinery unchanged as extra heads — including GQA:
+    with g = H/Hkv query groups, folded q head ``b*H + h`` integer-
+    divides by g to kv head ``b*Hkv + h//g``, i.e. exactly board ``b``'s
+    own kv heads. Ring ``ppermute`` payloads become (B*Hkv, n_local, d)
+    — one hop moves every request's K/V block."""
+    return x.reshape((x.shape[0] * x.shape[1],) + tuple(x.shape[2:]))
+
+
+def _fold_batch_probes(q, k, v):
+    """ShapeDtypeStruct twins of :func:`_fold_batch` over (q, k, v) —
+    engine-stamp functions probe shapes without touching data."""
+    return tuple(
+        jax.ShapeDtypeStruct(
+            (x.shape[0] * x.shape[1],) + tuple(x.shape[2:]), x.dtype)
+        for x in (q, k, v))
+
+
 def flash_engine_for(q, k, v) -> str:
     """Shape-aware engine provenance: the engine ``flash_attention``
     will actually dispatch THESE operands to, with the effective block
@@ -742,7 +764,16 @@ def flash_engine_for(q, k, v) -> str:
     :func:`tpu_flash_engine`): a block override that doesn't divide a
     timed sequence routes that shape to the jnp engine regardless of
     the flag. Sequences at or below the chunk size short-circuit to the
-    dense reference before any engine dispatch and stamp ``"dense"``."""
+    dense reference before any engine dispatch and stamp ``"dense"``.
+
+    4D ``(B, heads, seq, d)`` operands (the request-batched entry) fold
+    the batch into the head axis exactly as ``flash_attention`` does,
+    and the stamp gains a ``:b{B}`` suffix so recorded artifacts carry
+    the batching alongside the block edge. Works on
+    ``jax.ShapeDtypeStruct`` probes like the 3D form."""
+    if len(q.shape) == 4:
+        probe_q, probe_k, probe_v = _fold_batch_probes(q, k, v)
+        return flash_engine_for(probe_q, probe_k, probe_v) + f":b{q.shape[0]}"
     if q.shape[1] <= _Q_CHUNK:  # mirrors _attention_chunked's ordering
         return "dense"
     plan = _flash_dispatch_plan(q, k, v)
@@ -1609,7 +1640,14 @@ def ring_hop_engine_for(q, k, v, *, p: int | None = None,
     uses). A 1-device ring never enters the ring body; its local engine
     is reported as ``"local:<flash_engine_for stamp>"``. Recorders
     publishing ring timings must stamp artifacts with this, exactly as
-    single-device recorders stamp :func:`flash_engine_for`."""
+    single-device recorders stamp :func:`flash_engine_for`. 4D
+    ``(B, heads, seq, d)`` operands stamp the folded-batch engine with
+    a ``:b{B}`` suffix (see :func:`_fold_batch`)."""
+    if len(q.shape) == 4:
+        probe_q, probe_k, probe_v = _fold_batch_probes(q, k, v)
+        return ring_hop_engine_for(
+            probe_q, probe_k, probe_v, p=p, causal=causal, layout=layout
+        ) + f":b{q.shape[0]}"
     if p is None:
         p = len(jax.devices())
     h, n, d = q.shape
@@ -1641,7 +1679,13 @@ def ring_hop_bwd_engine_for(q, k, v, *, p: int | None = None,
     ``flash_hop_bwd.MAX_BLOCK``. A 1-device ring reports its local
     engine (whose stamp already carries the kernel backward edge when
     it differs). Recorders publishing ring GRADIENT timings must stamp
-    artifacts with this, alongside :func:`ring_hop_engine_for`."""
+    artifacts with this, alongside :func:`ring_hop_engine_for`. 4D
+    operands fold and stamp ``:b{B}`` exactly as the forward twin."""
+    if len(q.shape) == 4:
+        probe_q, probe_k, probe_v = _fold_batch_probes(q, k, v)
+        return ring_hop_bwd_engine_for(
+            probe_q, probe_k, probe_v, p=p, causal=causal, layout=layout
+        ) + f":b{q.shape[0]}"
     if p is None:
         p = len(jax.devices())
     h, n, d = q.shape
@@ -2045,7 +2089,24 @@ def ring_attention(
     and backward — roughly halving the causal trip's critical path. Operands must arrive in zigzag order
     (:func:`zigzag_shard`; invert outputs/gradients with
     :func:`zigzag_unshard`); needs ``seq % (2 * mesh size) == 0``.
+
+    4D ``(B, heads, seq, head_dim)`` operands run B independent
+    requests in ONE ring trip: the batch folds into the (unsharded)
+    head axis (:func:`_fold_batch` — GQA grouping preserved per
+    request, ``ppermute`` payloads carrying every request's K/V block
+    per hop), the fold machinery runs unchanged, and the output
+    unfolds to ``(B, heads, seq, head_dim)``. Differentiable like the
+    3D form; :func:`ring_hop_engine_for` stamps the shape ``:b{B}``.
     """
+    if q.ndim == 4:
+        if not (k.ndim == v.ndim == 4 and k.shape[0] == q.shape[0]):
+            raise ValueError(
+                f"ring_attention: batched q {q.shape} needs k/v with the "
+                f"same leading batch, got {k.shape} / {v.shape}")
+        out = ring_attention(
+            _fold_batch(q), _fold_batch(k), _fold_batch(v),
+            mesh=mesh, axis=axis, causal=causal, layout=layout)
+        return out.reshape(q.shape)
     if mesh is None:
         mesh = mesh_lib.make_mesh_1d(axis=axis)
     p = mesh.shape[axis]
@@ -2137,7 +2198,18 @@ def flash_attention(
     equal-head directly, budget-fitting GQA via broadcast K/V
     (:func:`_flash_dispatch_plan`); ``MOMP_TPU_FLASH=0`` forces the jnp
     engine. Shapes ``(heads, seq, head_dim)``; ``k``/``v`` may carry
-    fewer heads as long as they divide ``q``'s."""
+    fewer heads as long as they divide ``q``'s. 4D
+    ``(B, heads, seq, head_dim)`` operands fold the request batch into
+    the head axis (:func:`_fold_batch` — GQA grouping preserved per
+    request) and unfold on the way out; one dispatch serves all B."""
+    if q.ndim == 4:
+        if not (k.ndim == v.ndim == 4 and k.shape[0] == q.shape[0]):
+            raise ValueError(
+                f"flash_attention: batched q {q.shape} needs k/v with the "
+                f"same leading batch, got {k.shape} / {v.shape}")
+        out = flash_attention(
+            _fold_batch(q), _fold_batch(k), _fold_batch(v), causal=causal)
+        return out.reshape(q.shape)
     _check_gqa(q, k, v, "flash_attention")
     return _attention_chunked(q, k, v, causal)
 

@@ -1,0 +1,32 @@
+"""Micro-batching front door over the batched native engines.
+
+The reference serves exactly one workload per process launch (``mpirun
+-np N ./life``); the batched execution layer (``ops.bitlife`` B-board
+kernels, ``models.LifeSim`` stacked boards) removes the one-board-per-
+dispatch limit, and this package supplies the request-collecting layer
+on top: callers :meth:`~ShapeBucketBatcher.submit` independent boards,
+:meth:`~ShapeBucketBatcher.flush` groups them into shape buckets and
+advances each bucket in ONE device dispatch through
+``ops.pallas_life.life_run_vmem_batch``.
+
+Why bucketing matters: every distinct ``(B, ny, nx)`` stack shape is
+one compiled XLA program, and at ~70 ms host<->device RTT through the
+relay an uncontrolled shape set would spend its life retracing. The
+batcher therefore (a) keys buckets on board shape+dtype, (b) pads each
+dispatch's batch up to a power of two capped at ``max_batch`` (zero
+boards, sliced off afterwards — a dead board stays dead under Life's
+rule, so padding can never perturb live boards), and (c) leans on the
+step count being a RUNTIME scalar on every batched path, so requests
+with different step counts share one compiled program. The compiled-
+program set is thus at most ``log2(max_batch)+1`` programs per board
+shape, verified idle via the ``jit.retrace`` counters
+(``obs.metrics.get("jit.retrace", fn="life_batch_...")`` — the PR-4
+observability layer ticks them inside each batched jit body, once per
+compile).
+"""
+
+from mpi_and_open_mp_tpu.serve.batcher import (  # noqa: F401
+    ShapeBucketBatcher,
+    bucket_batch_size,
+    retrace_counts,
+)

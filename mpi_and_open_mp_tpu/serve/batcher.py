@@ -1,0 +1,180 @@
+"""Shape-bucketed micro-batch dispatcher for Life boards.
+
+See the package docstring for the serving model. The implementation is
+deliberately host-side and synchronous — a queue of submitted boards,
+one :meth:`ShapeBucketBatcher.flush` draining it bucket by bucket —
+because the expensive resource being managed is DISPATCHES, not
+threads: one flush turns R same-shape requests into
+``ceil(R / max_batch)`` device programs instead of R.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+_BATCH_FNS = (
+    "life_batch_vmem",
+    "life_batch_xla",
+    "life_batch_fused",
+    "life_batch_frame",
+)
+
+
+def bucket_batch_size(n_requests: int, max_batch: int) -> int:
+    """The padded batch a dispatch of ``n_requests`` same-shape boards
+    uses: the next power of two, capped at ``max_batch``. The cap keeps
+    the compiled-program set to at most ``log2(max_batch)+1`` stack
+    shapes per board shape; the pow-2 rounding means a bucket that grows
+    request by request re-compiles O(log R) times, not O(R)."""
+    if n_requests < 1:
+        raise ValueError(f"bucket_batch_size: need >= 1 request, got {n_requests}")
+    b = 1
+    while b < n_requests and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+def retrace_counts() -> dict[str, int]:
+    """Compile counts per batched engine since the last
+    ``obs.metrics.reset()`` — the bucketing verification: after a flush
+    over K shape buckets (one padded size each), the values here sum to
+    K. Zero-valued engines are omitted, matching the metrics registry."""
+    from mpi_and_open_mp_tpu.obs import metrics
+
+    out = {}
+    for fn in _BATCH_FNS:
+        n = metrics.get("jit.retrace", fn=fn)
+        if n:
+            out[fn] = int(n)
+    return out
+
+
+@dataclass
+class _Request:
+    ticket: int
+    board: np.ndarray
+    steps: int
+
+
+@dataclass
+class _BatchStat:
+    """One dispatched device program, as reported by ``last_flush_stats``."""
+
+    shape: tuple[int, int]
+    steps: int
+    requests: int
+    padded_batch: int
+    path: str
+    tickets: tuple[int, ...] = field(default_factory=tuple)
+
+
+class ShapeBucketBatcher:
+    """Collect independent Life requests; flush them in shape buckets.
+
+    ``submit(board, steps)`` enqueues a 2D board and returns a ticket;
+    ``flush()`` advances everything queued and returns the results in
+    SUBMISSION order (ticket order), one host array per request. Boards
+    bucket by ``(shape, dtype)``; inside a bucket, requests with the
+    same step count share a dispatch (different step counts need
+    separate dispatches — all boards in a stack advance together — but
+    still share the compiled program, steps being a runtime scalar).
+
+    Every dispatch emits a ``serve.batch`` trace span (shape, steps,
+    live/padded batch, native path) and ticks ``serve.requests`` /
+    ``serve.batches`` / ``serve.padding`` metrics, so a bench or a CI
+    run can audit exactly how many programs served how many requests.
+    """
+
+    def __init__(self, max_batch: int = 8):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self._queue: list[_Request] = []
+        self._next_ticket = 0
+        self.last_flush_stats: list[_BatchStat] = []
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def submit(self, board: np.ndarray, steps: int) -> int:
+        """Enqueue one board for ``steps`` Life steps; returns a ticket
+        (the request's index in the next flush's result list)."""
+        board = np.asarray(board)
+        if board.ndim != 2:
+            raise ValueError(
+                f"submit: one 2D board per request, got shape {board.shape}"
+                " (stacks are the ENGINE layout; the batcher builds them)")
+        steps = int(steps)
+        if steps < 0:
+            raise ValueError(f"submit: steps must be >= 0, got {steps}")
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._queue.append(_Request(ticket, board, steps))
+        return ticket
+
+    def bucket_keys(self) -> list[tuple]:
+        """The distinct (shape, dtype) buckets currently queued, in
+        first-submission order."""
+        seen: dict[tuple, None] = {}
+        for r in self._queue:
+            seen.setdefault((r.board.shape, r.board.dtype.str), None)
+        return list(seen)
+
+    def flush(self) -> list[np.ndarray]:
+        """Advance every queued request; results in submission order."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+        from mpi_and_open_mp_tpu.ops import pallas_life
+
+        import jax
+
+        results: dict[int, np.ndarray] = {}
+        stats: list[_BatchStat] = []
+        on_tpu = jax.default_backend() == "tpu"
+
+        # Bucket by (shape, dtype), sub-group by steps, chunk at
+        # max_batch. Grouping is order-preserving within a bucket so the
+        # span/ticket bookkeeping reads naturally in traces.
+        buckets: dict[tuple, list[_Request]] = {}
+        for r in self._queue:
+            buckets.setdefault((r.board.shape, r.board.dtype.str), []).append(r)
+
+        for (shape, _dtype), reqs in buckets.items():
+            by_steps: dict[int, list[_Request]] = {}
+            for r in reqs:
+                by_steps.setdefault(r.steps, []).append(r)
+            for steps, group in by_steps.items():
+                for lo in range(0, len(group), self.max_batch):
+                    chunk = group[lo:lo + self.max_batch]
+                    padded = bucket_batch_size(len(chunk), self.max_batch)
+                    stack = np.zeros((padded, *shape), dtype=chunk[0].board.dtype)
+                    for i, r in enumerate(chunk):
+                        stack[i] = r.board
+                    path = pallas_life.native_path_batch(
+                        stack.shape, on_tpu=on_tpu)
+                    with trace.span(
+                        "serve.batch", shape=f"{shape[0]}x{shape[1]}",
+                        steps=steps, requests=len(chunk), padded=padded,
+                        path=path,
+                    ) as sp:
+                        out = pallas_life.life_run_vmem_batch(
+                            jnp.asarray(stack), steps)
+                        sp.anchor(out)
+                    host = np.asarray(out)[: len(chunk)]
+                    for i, r in enumerate(chunk):
+                        results[r.ticket] = host[i]
+                    metrics.inc("serve.requests", len(chunk))
+                    metrics.inc("serve.batches")
+                    if padded > len(chunk):
+                        metrics.inc("serve.padding", padded - len(chunk))
+                    stats.append(_BatchStat(
+                        shape=shape, steps=steps, requests=len(chunk),
+                        padded_batch=padded, path=path,
+                        tickets=tuple(r.ticket for r in chunk)))
+
+        ordered = [results[r.ticket] for r in self._queue]
+        self._queue.clear()
+        self.last_flush_stats = stats
+        return ordered

@@ -1,0 +1,357 @@
+"""Batched execution layer: B-board engines, batched LifeSim, batched
+ring attention, and the serve-layer micro-batcher.
+
+The contract under test everywhere: a batch is B INDEPENDENT problems
+sharing one dispatch — every board/request must come out bit-identical
+(boards) or numerically identical (attention) to B serial runs. The
+Pallas runs are interpret-mode on CPU (same kernel code Mosaic compiles
+on TPU); the vmapped XLA paths are the identical compiled code used on
+every backend.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import oracle_n as _oracle
+
+from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+
+def _soup(ny, nx, seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    return (rng.random((ny, nx)) < density).astype(np.uint8)
+
+
+def _stack(b, ny, nx, seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    return (rng.random((b, ny, nx)) < density).astype(np.uint8)
+
+
+SHAPES = [(3, 5), (10, 10), (31, 8), (33, 37), (100, 33)]
+
+
+# ------------------------------------------------------------- ops layer
+
+
+@pytest.mark.parametrize("ny,nx", SHAPES)
+def test_pack_boards_roundtrip(ny, nx):
+    s = _stack(3, ny, nx)
+    packed = bitlife.pack_boards(jnp.asarray(s))
+    assert packed.shape == (3, bitlife.n_words(ny), nx)
+    assert np.array_equal(np.asarray(bitlife.unpack_boards(packed, ny)), s)
+
+
+@pytest.mark.parametrize("resident", [True, False])
+@pytest.mark.parametrize("ny,nx", SHAPES)
+def test_vmem_bits_batch_parity(ny, nx, resident):
+    # Both kernel forms: whole-stack-resident and grid-over-batch. The
+    # serial twin (not the oracle directly) is the sharper check — any
+    # divergence is THE batching bug, not a rule bug.
+    s = _stack(4, ny, nx, seed=ny * nx)
+    got = np.asarray(bitlife.life_run_vmem_bits_batch(
+        jnp.asarray(s), 7, interpret=True, resident=resident))
+    for b in range(4):
+        serial = np.asarray(bitlife.life_run_vmem_bits(
+            jnp.asarray(s[b]), 7, interpret=True))
+        assert np.array_equal(got[b], serial), f"board {b}"
+        assert np.array_equal(got[b], _oracle(s[b], 7)), f"board {b} oracle"
+
+
+@pytest.mark.parametrize("ny,nx", SHAPES)
+def test_xla_bits_batch_parity(ny, nx):
+    s = _stack(5, ny, nx, seed=7)
+    got = np.asarray(bitlife.life_run_bits_xla_batch(jnp.asarray(s), 6))
+    for b in range(5):
+        assert np.array_equal(got[b], _oracle(s[b], 6)), f"board {b}"
+
+
+def test_fused_bits_batch_parity():
+    # The fused tiling needs >= 8 packed words (256+ rows).
+    assert bitlife.fused_bits_supported((256, 128))
+    s = _stack(2, 256, 128, seed=3)
+    got = np.asarray(bitlife.life_run_fused_bits_batch(
+        jnp.asarray(s), 5, interpret=True))
+    for b in range(2):
+        assert np.array_equal(got[b], _oracle(s[b], 5)), f"board {b}"
+
+
+def test_frame_bits_batch_parity():
+    # Unaligned shape -> the padded-torus-frame runner.
+    assert bitlife.plan_sharded_bits((100, 40), 1, 1, False, False) is not None
+    s = _stack(2, 100, 40, seed=9)
+    got = np.asarray(bitlife.life_run_frame_bits_batch(
+        jnp.asarray(s), 5, interpret=True))
+    for b in range(2):
+        assert np.array_equal(got[b], _oracle(s[b], 5)), f"board {b}"
+
+
+def test_fits_vmem_packed_batch_scales_with_b():
+    # The batched gate is B x the per-board working set: a shape that
+    # fits alone must stop fitting at some batch.
+    shape = (3000, 3000)
+    assert bitlife.fits_vmem_packed(shape)
+    assert bitlife.fits_vmem_packed_batch((1, *shape))
+    assert not bitlife.fits_vmem_packed_batch((64, *shape))
+
+
+def test_native_path_batch_policy():
+    # Off-TPU: always the vmapped XLA loop (throughput, not interpret).
+    assert pallas_life.native_path_batch((8, 500, 500), on_tpu=False) == "xla"
+    # On-TPU ladder: whole-stack resident -> grid -> fused -> frame.
+    assert pallas_life.native_path_batch((2, 100, 100), on_tpu=True) == "vmem"
+    big = (64, 3000, 3000)
+    assert pallas_life.native_path_batch(big, on_tpu=True) == "vmem-grid"
+    assert pallas_life.native_path_batch(
+        (2, 16384, 16384), on_tpu=True) == "fused"
+    assert pallas_life.native_path_batch(
+        (2, 10000, 10000), on_tpu=True) == "frame"
+
+
+def test_life_run_vmem_batch_dispatch_parity():
+    # The public batched dispatcher (on CPU: the XLA path), vs serial.
+    s = _stack(6, 33, 37, seed=1)
+    got = np.asarray(pallas_life.life_run_vmem_batch(jnp.asarray(s), 7))
+    for b in range(6):
+        assert np.array_equal(got[b], _oracle(s[b], 7)), f"board {b}"
+
+
+def test_batched_steps_is_runtime_scalar():
+    # One compiled program per stack shape serves ANY step count — the
+    # serve-layer bucketing contract, observable via jit.retrace.
+    from mpi_and_open_mp_tpu.obs import metrics
+
+    metrics.reset()
+    s = jnp.asarray(_stack(2, 20, 20))
+    for n in (1, 3, 9):
+        bitlife.life_run_bits_xla_batch(s, n)
+    assert metrics.get("jit.retrace", fn="life_batch_xla") == 1
+    metrics.reset()
+
+
+# ----------------------------------------------------------- model layer
+
+
+def _cfg(ny, nx, steps):
+    from mpi_and_open_mp_tpu.utils.config import config_from_board
+
+    return config_from_board(np.zeros((ny, nx), np.uint8), steps=steps,
+                             save_steps=0)
+
+
+@pytest.mark.parametrize("impl", ["auto", "roll"])
+def test_lifesim_batched_parity(impl):
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+
+    s = _stack(4, 33, 37, seed=2)
+    cfg = _cfg(33, 37, 7)
+    sim = LifeSim(cfg, layout="serial", impl=impl, initial_board=s)
+    assert sim.batch == 4
+    sim.run()
+    out = np.asarray(sim.collect())
+    assert out.shape == (4, 33, 37)
+    for b in range(4):
+        serial = LifeSim(_cfg(33, 37, 7), layout="serial", impl="roll",
+                         initial_board=s[b])
+        serial.run()
+        assert np.array_equal(out[b], np.asarray(serial.collect())), \
+            f"board {b}"
+    # The per-board honesty gate must pass on the advanced stack.
+    sim.debug_check()
+
+
+def test_lifesim_batched_auto_picks_batched_dispatcher():
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+
+    sim = LifeSim(_cfg(20, 20, 2), layout="serial",
+                  initial_board=_stack(3, 20, 20))
+    assert sim.impl == "pallas"
+    assert sim.plan_note.startswith("batch:")
+
+
+def test_lifesim_batched_constructor_gates():
+    from mpi_and_open_mp_tpu.models.life import LifeSim
+
+    s = _stack(2, 10, 10)
+    with pytest.raises(ValueError, match="serial"):
+        LifeSim(_cfg(10, 10, 1), layout="row", initial_board=s)
+    for kw in (dict(impl="halo"), dict(impl="bitfused"),
+               dict(outdir="/tmp/nope"), dict(checkpoint_dir="/tmp/nope")):
+        with pytest.raises(ValueError):
+            LifeSim(_cfg(10, 10, 1), layout="serial", initial_board=s, **kw)
+    with pytest.raises(ValueError, match="expected"):
+        LifeSim(_cfg(10, 10, 1), layout="serial",
+                initial_board=_stack(2, 11, 10))
+
+
+# ------------------------------------------------------- attention layer
+
+
+def _qkv(b, h, hkv, n, d, seed=5):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal((b, h, n, d)), jnp.float32),
+            jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32),
+            jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hkv", [4, 2])
+def test_ring_attention_batched_vs_per_request(causal, hkv):
+    from mpi_and_open_mp_tpu.parallel import context
+
+    q, k, v = _qkv(3, 4, hkv, 256, 16)
+    out = context.ring_attention(q, k, v, causal=causal)
+    assert out.shape == q.shape
+    for b in range(3):
+        ref = context.ring_attention(q[b], k[b], v[b], causal=causal)
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_flash_attention_batched_vs_per_request():
+    from mpi_and_open_mp_tpu.parallel import context
+
+    q, k, v = _qkv(2, 4, 2, 128, 16, seed=6)
+    out = context.flash_attention(q, k, v, causal=True)
+    for b in range(2):
+        ref = context.flash_attention(q[b], k[b], v[b], causal=True)
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_batched_grads_match():
+    from mpi_and_open_mp_tpu.parallel import context
+
+    q, k, v = _qkv(2, 2, 2, 128, 8, seed=8)
+
+    g_batch = jax.grad(
+        lambda q_: jnp.sum(context.ring_attention(q_, k, v, causal=True) ** 2)
+    )(q)
+    for b in range(2):
+        g_one = jax.grad(
+            lambda q_: jnp.sum(
+                context.ring_attention(q_, k[b], v[b], causal=True) ** 2)
+        )(q[b])
+        np.testing.assert_allclose(np.asarray(g_batch[b]), np.asarray(g_one),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_batched_rejects_mismatched_batch():
+    from mpi_and_open_mp_tpu.parallel import context
+
+    q, k, v = _qkv(3, 4, 4, 128, 16)
+    with pytest.raises(ValueError, match="batch"):
+        context.ring_attention(q, k[:2], v, causal=False)
+    with pytest.raises(ValueError, match="batch"):
+        context.flash_attention(q, k[:2], v)
+
+
+def test_engine_stamps_carry_batch_suffix():
+    # Pure shape analysis — must work on ShapeDtypeStruct probes.
+    from mpi_and_open_mp_tpu.parallel import context
+
+    sq = jax.ShapeDtypeStruct((5, 8, 8192, 128), jnp.bfloat16)
+    skv = jax.ShapeDtypeStruct((5, 2, 8192, 128), jnp.bfloat16)
+    for fn in (context.flash_engine_for,
+               lambda *a: context.ring_hop_engine_for(*a, p=8, causal=True),
+               lambda *a: context.ring_hop_bwd_engine_for(*a, p=8,
+                                                          causal=True)):
+        stamp = fn(sq, skv, skv)
+        assert stamp.endswith(":b5"), stamp
+        # The base stamp is exactly the folded-shape 3D stamp.
+        fq = jax.ShapeDtypeStruct((40, 8192, 128), jnp.bfloat16)
+        fkv = jax.ShapeDtypeStruct((10, 8192, 128), jnp.bfloat16)
+        assert stamp == fn(fq, fkv, fkv) + ":b5"
+
+
+# ----------------------------------------------------------- serve layer
+
+
+def test_bucket_batch_size():
+    from mpi_and_open_mp_tpu.serve import bucket_batch_size
+
+    assert [bucket_batch_size(n, 8) for n in (1, 2, 3, 4, 5, 7, 8)] == \
+        [1, 2, 4, 4, 8, 8, 8]
+    assert bucket_batch_size(3, 2) == 2  # cap wins over pow2
+    with pytest.raises(ValueError):
+        bucket_batch_size(0, 8)
+
+
+def test_batcher_results_in_submission_order():
+    from mpi_and_open_mp_tpu.serve import ShapeBucketBatcher
+
+    bat = ShapeBucketBatcher(max_batch=4)
+    boards = [_soup(20, 20, seed=i) for i in range(3)]
+    other = _soup(10, 10, seed=9)
+    # Interleave shapes so submission order != bucket order.
+    t0 = bat.submit(boards[0], 4)
+    t1 = bat.submit(other, 2)
+    t2 = bat.submit(boards[1], 4)
+    t3 = bat.submit(boards[2], 6)  # same shape, different steps
+    assert (t0, t1, t2, t3) == (0, 1, 2, 3)
+    assert len(bat) == 4
+    res = bat.flush()
+    assert len(res) == 4 and len(bat) == 0
+    assert np.array_equal(res[0], _oracle(boards[0], 4))
+    assert np.array_equal(res[1], _oracle(other, 2))
+    assert np.array_equal(res[2], _oracle(boards[1], 4))
+    assert np.array_equal(res[3], _oracle(boards[2], 6))
+
+
+def test_batcher_pads_to_pow2_and_counts():
+    from mpi_and_open_mp_tpu.obs import metrics
+    from mpi_and_open_mp_tpu.serve import ShapeBucketBatcher
+
+    metrics.reset()
+    bat = ShapeBucketBatcher(max_batch=8)
+    for i in range(3):
+        bat.submit(_soup(16, 16, seed=i), 3)
+    bat.flush()
+    (stat,) = bat.last_flush_stats
+    assert stat.requests == 3 and stat.padded_batch == 4
+    assert stat.shape == (16, 16) and stat.steps == 3
+    assert metrics.get("serve.requests") == 3
+    assert metrics.get("serve.batches") == 1
+    assert metrics.get("serve.padding") == 1
+    metrics.reset()
+
+
+def test_batcher_rejects_bad_submissions():
+    from mpi_and_open_mp_tpu.serve import ShapeBucketBatcher
+
+    bat = ShapeBucketBatcher(max_batch=4)
+    with pytest.raises(ValueError, match="2D"):
+        bat.submit(_stack(2, 8, 8), 1)
+    with pytest.raises(ValueError, match="steps"):
+        bat.submit(_soup(8, 8), -1)
+    with pytest.raises(ValueError, match="max_batch"):
+        ShapeBucketBatcher(max_batch=0)
+
+
+def test_one_retrace_per_shape_bucket():
+    # THE bucketing acceptance: a flush over K shape buckets compiles
+    # exactly K programs, and a SECOND flush over the same buckets (any
+    # step counts, any request counts up to the same padded size)
+    # compiles ZERO more.
+    from mpi_and_open_mp_tpu.obs import metrics
+    from mpi_and_open_mp_tpu.serve import ShapeBucketBatcher, retrace_counts
+
+    metrics.reset()
+    bat = ShapeBucketBatcher(max_batch=4)
+    for i in range(4):
+        bat.submit(_soup(24, 24, seed=i), 2)
+    for i in range(4):
+        bat.submit(_soup(12, 40, seed=i), 5)
+    bat.flush()
+    counts = retrace_counts()
+    assert sum(counts.values()) == 2, counts  # one per shape bucket
+    # Same buckets again, different step counts: zero new compiles.
+    for i in range(4):
+        bat.submit(_soup(24, 24, seed=10 + i), 9)
+    for i in range(4):
+        bat.submit(_soup(12, 40, seed=10 + i), 1)
+    bat.flush()
+    assert sum(retrace_counts().values()) == 2, retrace_counts()
+    metrics.reset()
