@@ -1,0 +1,149 @@
+"""One-shot backfill: committed bench artifacts → the run ledger.
+
+Usage::
+
+    python analysis/ledger_backfill.py                 # repo defaults
+    python analysis/ledger_backfill.py --root DIR --ledger OUT.jsonl
+
+Feeds the pre-ledger committed measurements — the driver's end-of-round
+``BENCH_r0*.json`` wrappers and the real-chip ``results/bench_tpu_r05.jsonl``
+lines — through ``obs.ledger.stamp`` so the regression sentinel has a
+real-chip baseline from day one, including the r04/r05 CPU-fallback lines
+whose silent ~1000× degradation is the sentinel's founding motivation
+(run ``python analysis/regression_sentinel.py results/ledger.jsonl`` and
+watch it flag exactly those).
+
+Normalisation: r01–r03 predate the steady-state schema rename
+(``life_cups_p46gun_big`` with ``steady_state_cups``); they are mapped
+onto the current field names and stamped ``backfill_normalized`` so
+nobody mistakes the mapping for an original record. All committed lines
+are the flagship workload (500² board, 10 000 steps, uint8, single
+chip/host — see results/README.md), so those key fields are filled in
+where the old lines omitted them. Timestamps come from the jax warning
+lines in each wrapper's ``tail``; the bench_tpu_r05 lines use the
+documented 2026-07-31 morning chip window. ``git_sha`` is stamped
+``pre-ledger`` — the true SHAs predate this machinery.
+
+Idempotent: entries whose ``source`` is already in the ledger are
+skipped, so re-running after a partial append is safe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import calendar
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_and_open_mp_tpu.obs import ledger  # noqa: E402
+
+# All committed bench lines are the flagship p46gun_big workload.
+_FLAGSHIP = {"board": [500, 500], "steps": 10_000, "dtype": "uint8"}
+
+# The r05 chip lines' documented recording window (results/README.md).
+_R05_WINDOW_TS = calendar.timegm(time.strptime(
+    "2026-07-31 09:00:00", "%Y-%m-%d %H:%M:%S"))
+
+_TS_RE = re.compile(r"(\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2})")
+
+
+def _ts_from_tail(tail: str, fallback: float) -> float:
+    m = _TS_RE.search(tail or "")
+    if not m:
+        return fallback
+    return float(calendar.timegm(
+        time.strptime(m.group(1), "%Y-%m-%d %H:%M:%S")))
+
+
+def _normalize(rec: dict) -> dict:
+    """Map a committed bench line onto the current schema + key fields."""
+    if rec.get("metric") == "life_cups_p46gun_big":  # r01-r03 old schema
+        rec = {
+            "metric": "life_steady_cups_p46gun_big",
+            "value": rec["steady_state_cups"],
+            "unit": rec["unit"],
+            "vs_baseline": rec["steady_state_vs_baseline"],
+            "end_to_end_sec": rec["elapsed_sec"],
+            "end_to_end_cups": rec["value"],
+            "end_to_end_vs_baseline": rec["vs_baseline"],
+            "steady_is_differenced": True,
+            "backend": rec["backend"],
+            "impl": rec["impl"],
+            "backfill_normalized": True,
+        }
+    else:
+        rec = dict(rec)
+    for field, default in _FLAGSHIP.items():
+        rec.setdefault(field, default)
+    return rec
+
+
+def _entries_from(root: str) -> list[dict]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r0*.json"))):
+        name = os.path.basename(path)
+        with open(path) as fd:
+            wrapper = json.load(fd)
+        rec = _normalize(wrapper["parsed"])
+        platform = rec.get("backend", "?")
+        out.append(ledger.stamp(
+            rec, source=f"backfill:{name}",
+            platform=platform, device_kind="unrecorded", device_count=1,
+            ts=_ts_from_tail(wrapper.get("tail", ""),
+                             fallback=float(wrapper.get("n", 0))),
+            sha="pre-ledger"))
+    chip = os.path.join(root, "results", "bench_tpu_r05.jsonl")
+    if os.path.exists(chip):
+        with open(chip) as fd:
+            for i, line in enumerate(fd, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                rec = _normalize(json.loads(line))
+                out.append(ledger.stamp(
+                    rec, source=f"backfill:results/bench_tpu_r05.jsonl#L{i}",
+                    platform=rec.get("backend", "tpu"),
+                    device_kind="unrecorded", device_count=1,
+                    ts=_R05_WINDOW_TS + 600.0 * (i - 1),
+                    sha="pre-ledger"))
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="analysis/ledger_backfill.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p.add_argument("--root", default=repo,
+                   help="repo root holding BENCH_r0*.json + results/ "
+                   "(default: this repo)")
+    p.add_argument("--ledger", default=None,
+                   help="ledger to append to "
+                   "(default: ROOT/results/ledger.jsonl)")
+    args = p.parse_args(argv)
+    path = args.ledger or os.path.join(args.root, "results", "ledger.jsonl")
+
+    have = set()
+    if os.path.exists(path):
+        have = {e.get("source") for e in ledger.load(path)}
+    entries = _entries_from(args.root)
+    added = 0
+    for entry in entries:
+        if entry["source"] in have:
+            continue
+        ledger.append(entry, path)
+        added += 1
+    print(json.dumps({"ledger": path, "backfilled": added,
+                      "skipped": len(entries) - added}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+"""Regression sentinel: gate the newest ledger entry against its history.
+
+Usage::
+
+    python analysis/regression_sentinel.py results/ledger.jsonl
+    python analysis/regression_sentinel.py LEDGER --n 5 --noise 0.1 \
+        --match metric,shape,dtype,steps,batch
+
+Compares the NEWEST non-error ledger entry (``obs.ledger`` schema)
+against a rolling median-of-N baseline over the previous entries with the
+same workload key, and prints ONE JSON verdict line —
+``tpu_queue_loop.sh`` and the CI sentinel job gate on the exit code:
+
+* 0 — ``"pass"`` (every watched rate within the noise floor, no engine
+  downgrade) or ``"no-baseline"`` (first run of a configuration).
+* 1 — ``"fail"``: a watched rate regressed past the noise floor, or the
+  engine/backend provenance downgraded (pallas→jnp, TPU→CPU fallback —
+  the exact failure BENCH_r04/r05 recorded silently).
+* 2 — unreadable/malformed ledger.
+
+The match key deliberately EXCLUDES topology and engine by default: a run
+that fell back to CPU must land in the same comparison group as its
+real-chip history (that is the regression), not escape into a fresh key.
+Add fields via ``--match`` for per-topology trending instead.
+
+Rates are judged against the MEDIAN of the baseline window (robust to a
+single outlier run); provenance against the BEST rank the window reached
+(one good run proves the configuration can run that engine, so anything
+lower is a downgrade until it ages out of the window). End-to-end wall
+seconds are deliberately not watched — they carry the ~70 ms tunnel RTT
+(±16 % across identical code, see bench.py), which is noise here; the
+steady-state/differenced rates are the signal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+# Verdicts are host-side work over a JSONL file; never touch the chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_and_open_mp_tpu.obs import ledger  # noqa: E402
+
+#: (record field, direction) — checked whenever the field is present on
+#: the candidate AND at least one baseline record. All are steady-state /
+#: differenced numbers (RTT-cancelled), so the noise floor can be tight.
+WATCH_FIELDS = (
+    ("value", "higher"),
+    ("sharded_steady_cups", "higher"),
+    ("batched_cups", "higher"),
+    ("batched_steady_cups", "higher"),
+    ("batched_requests_per_sec", "higher"),
+    ("attention_32k_causal_tflops", "higher"),
+    ("attention_32k_grad_tflops", "higher"),
+    ("attention_32k_causal_sec", "lower"),
+    ("attention_32k_grad_sec", "lower"),
+)
+
+#: Record fields carrying engine provenance, rank-compared for downgrades.
+PROVENANCE_FIELDS = ("impl", "batch_engine", "attention_engine",
+                     "attention_hop_engine", "attention_hop_engine_bwd")
+
+DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch")
+
+_BACKEND_RANK = {"cpu": 0, "gpu": 1, "tpu": 2}
+
+
+def engine_rank(stamp) -> int:
+    """Coarse engine tiers: repo Pallas kernels > packed/fused native
+    paths > jnp/XLA folds. Suffixes (``:b1024``, ``:zz``, ``:bB``) and the
+    ``batch:``/``local:`` prefixes don't change the tier."""
+    s = str(stamp or "")
+    for prefix in ("batch:", "local:"):
+        if s.startswith(prefix):
+            s = s[len(prefix):]
+    if "pallas" in s:
+        return 3
+    if s.startswith(("bitfused", "vmem", "grid", "fused", "frame")):
+        return 2
+    return 1 if s else 0
+
+
+def _usable(entry: dict) -> bool:
+    rec = entry.get("record") or {}
+    return "error" not in rec
+
+
+def _match_key(entry: dict, fields: tuple[str, ...]) -> str:
+    return ledger.config_key(entry, fields)
+
+
+def evaluate(entries: list[dict], *, n: int = 5, noise: float = 0.1,
+             match: tuple[str, ...] = DEFAULT_MATCH) -> dict:
+    """The verdict dict for the newest usable entry of ``entries``."""
+    usable = sorted((e for e in entries if _usable(e)),
+                    key=lambda e: e.get("ts", 0.0))
+    if not usable:
+        return {"sentinel": "momp-regression-sentinel/1",
+                "verdict": "no-baseline",
+                "reason": "no non-error entries in the ledger"}
+    candidate = usable[-1]
+    key = _match_key(candidate, match)
+    pool = [e for e in usable[:-1] if _match_key(e, match) == key][-n:]
+    verdict = {
+        "sentinel": "momp-regression-sentinel/1",
+        "key": key,
+        "candidate_source": candidate.get("source", "?"),
+        "candidate_ts": candidate.get("ts"),
+        "candidate_git_sha": candidate.get("git_sha", "?"),
+        "baseline_n": len(pool),
+        "noise_floor": noise,
+    }
+    if not pool:
+        verdict["verdict"] = "no-baseline"
+        return verdict
+
+    cand_rec = candidate.get("record") or {}
+    regressions, downgrades, checked = [], [], []
+
+    for field, direction in WATCH_FIELDS:
+        new = cand_rec.get(field)
+        base_vals = [e["record"][field] for e in pool
+                     if isinstance((e.get("record") or {}).get(field),
+                                   (int, float))]
+        if not isinstance(new, (int, float)) or not base_vals:
+            continue
+        baseline = statistics.median(base_vals)
+        if baseline == 0:
+            continue
+        checked.append(field)
+        drop = ((baseline - new) / baseline if direction == "higher"
+                else (new - baseline) / abs(baseline))
+        if drop > noise:
+            regressions.append({
+                "field": field, "direction": direction,
+                "new": new, "baseline_median": baseline,
+                "drop": round(drop, 4),
+            })
+
+    # Backend/platform downgrade: the TPU→CPU fallback BENCH_r04 hid.
+    new_backend = candidate.get("platform") or cand_rec.get("backend")
+    base_backends = [e.get("platform") or (e.get("record") or {}).get(
+        "backend") for e in pool]
+    base_backends = [b for b in base_backends if b]
+    if new_backend and base_backends:
+        checked.append("platform")
+        best = max(base_backends, key=lambda b: _BACKEND_RANK.get(b, 0))
+        if (_BACKEND_RANK.get(new_backend, 0)
+                < _BACKEND_RANK.get(best, 0)):
+            item = {"field": "platform", "new": new_backend,
+                    "baseline_best": best}
+            if cand_rec.get("fallback_reason"):
+                item["fallback_reason"] = cand_rec["fallback_reason"]
+            downgrades.append(item)
+
+    for field in PROVENANCE_FIELDS:
+        new = cand_rec.get(field)
+        base = [(e.get("record") or {}).get(field) for e in pool]
+        base = [b for b in base if b is not None]
+        if new is None or not base:
+            continue
+        checked.append(field)
+        best = max(base, key=engine_rank)
+        if engine_rank(new) < engine_rank(best):
+            downgrades.append({"field": field, "new": new,
+                               "baseline_best": best})
+
+    verdict.update({
+        "checked": checked,
+        "regressions": regressions,
+        "downgrades": downgrades,
+        "verdict": "fail" if (regressions or downgrades) else "pass",
+    })
+    return verdict
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="analysis/regression_sentinel.py")
+    p.add_argument("ledger", help="obs.ledger JSONL file to judge")
+    p.add_argument("--n", type=int, default=5, metavar="N",
+                   help="rolling baseline window per configuration key "
+                   "(median of the last N matching runs; default 5)")
+    p.add_argument("--noise", type=float, default=0.1, metavar="FRAC",
+                   help="noise floor: drops up to this fraction of the "
+                   "baseline median pass (default 0.1)")
+    p.add_argument("--match", default=",".join(DEFAULT_MATCH),
+                   metavar="FIELDS",
+                   help="comma-separated key fields runs must share to be "
+                   "comparable (default %(default)s; add 'topology' or "
+                   "'engine' for per-topology trending)")
+    args = p.parse_args(argv)
+
+    try:
+        entries = ledger.load(args.ledger)
+    except (OSError, ValueError) as e:
+        print(f"regression_sentinel: {e}", file=sys.stderr)
+        return 2
+    match = tuple(f.strip() for f in args.match.split(",") if f.strip())
+    verdict = evaluate(entries, n=args.n, noise=args.noise, match=match)
+    print(json.dumps(verdict))
+    return 1 if verdict["verdict"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,9 @@ summary (span counts, engines, α+βn transfer fit when the trace carries
 two or more hop sizes), recoveries by stamp, and the jit-retrace counters
 from the last ``metrics`` snapshot event. ``--json`` emits the same data
 as one JSON object (``obs.report.report_dict`` schema) — what the CI
-trace cycle asserts against.
+trace cycle asserts against. ``--chrome OUT`` instead exports the spans
+to Chrome trace-event JSON (``obs.report.to_chrome``) so the timeline
+opens directly in Perfetto / chrome://tracing.
 """
 
 from __future__ import annotations
@@ -36,6 +38,9 @@ def main(argv=None) -> int:
     p.add_argument("trace", help="MOMP_TRACE JSONL file to summarise")
     p.add_argument("--json", action="store_true",
                    help="emit the report as one JSON object")
+    p.add_argument("--chrome", metavar="OUT",
+                   help="write Chrome trace-event JSON (Perfetto-loadable) "
+                   "here instead of reporting")
     args = p.parse_args(argv)
 
     try:
@@ -43,6 +48,13 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"trace_report: {e}", file=sys.stderr)
         return 2
+    if args.chrome:
+        chrome = report.to_chrome(records)
+        with open(args.chrome, "w") as fd:
+            json.dump(chrome, fd)
+        print(f"wrote {len(chrome['traceEvents'])} trace events "
+              f"to {args.chrome}")
+        return 0
     rep = report.report_dict(records)
     if args.json:
         print(json.dumps(rep))

@@ -25,6 +25,16 @@
 #                   (default 900)
 #   TPUQ_SETTLE     seconds between consecutive chip processes (default
 #                   60 — back-to-back claims have wedged the relay)
+#   TPUQ_LEDGER     run-ledger path exported to jobs as MOMP_LEDGER; after
+#                   each successful job the regression sentinel judges the
+#                   newest entry (host-side, CPU-pinned — never a chip
+#                   claim) and the verdict lands in LOG. Default:
+#                   results/ledger.jsonl next to this script's repo;
+#                   set empty to disable the ledger+sentinel step.
+#   TPUQ_SENTINEL_FATAL  1 = a sentinel "fail" verdict stops the loop
+#                   with exit 1 (CI semantics); default 0 = log the
+#                   REGRESSION and keep draining (operator semantics —
+#                   the queued jobs are usually the fix).
 set -u
 QUEUE=${1:?usage: tpu_queue_loop.sh QUEUE_DIR [LOG]}
 LOG=${2:-/tmp/tpu_queue.log}
@@ -34,8 +44,33 @@ LOG=${2:-/tmp/tpu_queue.log}
 PROBE=${TPUQ_PROBE_CMD:-"python -c 'import jax; print(jax.devices())'"}
 SLEEP=${TPUQ_SLEEP:-900}
 SETTLE=${TPUQ_SETTLE:-60}
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+LEDGER=${TPUQ_LEDGER-"$REPO/results/ledger.jsonl"}
+SENTINEL_FATAL=${TPUQ_SENTINEL_FATAL:-0}
+[ -n "$LEDGER" ] && export MOMP_LEDGER="$LEDGER"
 
 log() { echo "[$(date -u +%F' '%H:%M:%S)] $*" >>"$LOG"; }
+
+# Judge the newest ledger entry against its rolling baseline. Host-side
+# JSONL work: pinned to CPU so it can never claim the chip a queued job
+# is settling toward. Returns the sentinel's exit code (0 pass /
+# no-baseline, 1 regression, 2 unreadable ledger).
+sentinel() {
+    [ -n "$LEDGER" ] && [ -f "$LEDGER" ] || return 0
+    local verdict rc
+    verdict=$(JAX_PLATFORMS=cpu python "$REPO/analysis/regression_sentinel.py" \
+        "$LEDGER" 2>>"$LOG")
+    rc=$?
+    log "sentinel ($rc): $verdict"
+    if [ "$rc" -eq 1 ]; then
+        log "REGRESSION: newest run regressed vs its ledger baseline"
+        if [ "$SENTINEL_FATAL" = "1" ]; then
+            log "TPUQ_SENTINEL_FATAL=1; stopping loop"
+            exit 1
+        fi
+    fi
+    return "$rc"
+}
 
 log "loop start (pid $$, queue $QUEUE)"
 while true; do
@@ -58,6 +93,7 @@ while true; do
             if bash "$job" >>"$LOG" 2>&1; then
                 mkdir -p "$QUEUE/done" && mv "$job" "$QUEUE/done/"
                 log "done $job"
+                sentinel || true
             else
                 rc=$?
                 if [ "$rc" -eq 75 ]; then

@@ -22,8 +22,21 @@ when off:
     recorder. ``bench.py`` publishes ``snapshot()`` on its JSON line.
 ``report``
     Pure-host analysis of a trace file: per-phase breakdown, α+βn fit
-    over ring-hop transfer spans, recovery/retrace summary. CLI form:
-    ``analysis/trace_report.py``.
+    over ring-hop transfer spans, recovery/retrace summary, and a Chrome
+    trace-event exporter (``to_chrome``) so span timelines open in
+    Perfetto. CLI form: ``analysis/trace_report.py`` (``--chrome``).
+``ledger``
+    The CROSS-run layer: an append-only JSONL run ledger where every
+    bench line lands stamped with git SHA, platform/device kind, and a
+    (topology, shape, dtype, batch, engine) configuration key — the
+    baseline store ``analysis/regression_sentinel.py`` judges new runs
+    against. Stdlib-only; safe on chip-forbidden hosts.
+``profile``
+    Compiled-artifact introspection: ``cost_analysis()`` FLOPs/bytes per
+    phase, roofline placement against per-device-kind peaks, compile-time
+    histograms and live-buffer/memory gauges through the metrics
+    registry, and cost-cache hit/miss counters extending the retrace
+    accounting.
 """
 
-from mpi_and_open_mp_tpu.obs import metrics, trace  # noqa: F401
+from mpi_and_open_mp_tpu.obs import ledger, metrics, trace  # noqa: F401

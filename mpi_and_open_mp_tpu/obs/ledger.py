@@ -1,0 +1,166 @@
+"""Cross-run performance ledger: append-only JSONL, one entry per bench line.
+
+The reference repo's cross-run record is ``times.txt`` accumulation — raw
+seconds with no provenance, comparable only by whoever remembers what
+machine produced each line. PR 4's spans/metrics replaced the *in-process*
+half of that story; this module is the *across-runs* half: every
+``bench.py`` JSON line lands here stamped with the facts the sentinel
+(``analysis/regression_sentinel.py``) needs to notice when a number got
+worse or an engine silently downgraded — git SHA, platform, device kind,
+topology, and the configuration key. BENCH_r04/r05 recorded ~1000× slower
+CPU-fallback numbers with nothing watching; with the ledger, that is a
+one-command verdict.
+
+Entry schema, one JSON object per line (append-only; multiple processes
+may share one file, same discipline as the ``MOMP_TRACE`` sink)::
+
+    {"schema": "momp-ledger/1", "ts": <epoch sec>, "git_sha": ...,
+     "source": "bench.py" | "backfill:<file>#L<n>" | ...,
+     "platform": "tpu"|"cpu", "device_kind": ..., "topology": "tpu:1",
+     "key": {"metric", "topology", "shape", "dtype", "steps", "batch",
+             "engine"},
+     "record": {...the full bench JSON line...}}
+
+The query key is (topology, shape, dtype, batch, engine) plus the metric
+name — :func:`config_key` renders any subset of it as a stable string so
+baselines group per configuration. Keyed lookups deliberately support
+*subsets*: the sentinel matches on the workload fields only
+(metric/shape/dtype/steps/batch) so a TPU→CPU fallback run still lands in
+the same comparison group as its real-chip baseline instead of escaping
+into a fresh key.
+
+Everything here is stdlib-only (no jax import): the sentinel and the
+queue-loop gate must run on a host that is *not* allowed to touch the
+accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+
+ENV = "MOMP_LEDGER"
+
+#: Canonical key-field order; ``config_key`` renders them in this order.
+KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
+              "engine")
+
+_GIT_SHA: str | None = None
+
+
+def ledger_path(default: str | None = None) -> str | None:
+    """The ledger path from ``MOMP_LEDGER``, else ``default``."""
+    return os.environ.get(ENV) or default
+
+
+def git_sha(cwd: str | None = None) -> str:
+    """The repo HEAD SHA (short), cached; ``"unknown"`` outside a repo."""
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        if cwd is None:
+            cwd = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        try:
+            _GIT_SHA = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=cwd,
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except (OSError, subprocess.SubprocessError):
+            _GIT_SHA = "unknown"
+    return _GIT_SHA
+
+
+def _shape_str(record: dict) -> str:
+    board = record.get("board")
+    if (isinstance(board, (list, tuple)) and len(board) == 2
+            and all(isinstance(b, int) for b in board)):
+        return f"{board[0]}x{board[1]}"
+    return "?"
+
+
+def stamp(record: dict, *, source: str = "bench.py",
+          platform: str | None = None, device_kind: str | None = None,
+          device_count: int | None = None, ts: float | None = None,
+          sha: str | None = None) -> dict:
+    """Wrap one bench JSON line as a ledger entry.
+
+    ``platform``/``device_kind``/``device_count`` come from the caller
+    (who has jax in hand); when omitted they fall back to what the record
+    itself carries so backfilled lines stay honest about what was and was
+    not recorded at the time.
+    """
+    platform = platform or record.get("platform") or record.get(
+        "backend") or "?"
+    topology = f"{platform}:{device_count if device_count else '?'}"
+    key = {
+        "metric": record.get("metric", "?"),
+        "topology": topology,
+        "shape": _shape_str(record),
+        "dtype": record.get("dtype", "?"),
+        "steps": record.get("steps", "?"),
+        "batch": record.get("batch", 0),
+        "engine": record.get("impl", "?"),
+    }
+    return {
+        "schema": "momp-ledger/1",
+        "ts": time.time() if ts is None else ts,
+        "git_sha": sha if sha is not None else git_sha(),
+        "source": source,
+        "platform": platform,
+        "device_kind": device_kind or record.get("device_kind")
+        or "unrecorded",
+        "topology": topology,
+        "key": key,
+        "record": record,
+    }
+
+
+def append(entry: dict, path: str) -> None:
+    """Append one entry as one JSON line (parent dirs created)."""
+    outdir = os.path.dirname(path)
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    with open(path, "a") as fd:
+        fd.write(json.dumps(entry) + "\n")
+
+
+def load(path: str) -> list[dict]:
+    """Parse one entry per non-blank line; raise ``ValueError`` naming the
+    first malformed line (same discipline as ``obs.report.load`` — a
+    truncated tail from a killed process is a signal, not noise)."""
+    entries = []
+    with open(path) as fd:
+        for lineno, line in enumerate(fd, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: not a JSON record ({e.msg})") from e
+            if not isinstance(entry, dict) or "record" not in entry:
+                raise ValueError(
+                    f"{path}:{lineno}: entry without a 'record' field")
+            entries.append(entry)
+    return entries
+
+
+def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:
+    """Render an entry's key (or any subset of it) as a stable string,
+    e.g. ``metric=life_steady_cups_p46gun_big|shape=500x500|batch=0``."""
+    key = entry.get("key") or {}
+    return "|".join(f"{f}={key.get(f, '?')}" for f in fields)
+
+
+def query(entries: list[dict], **where) -> list[dict]:
+    """Entries whose key matches every ``field=value`` given (values
+    compared as strings, chronological order preserved)."""
+    out = []
+    for e in entries:
+        key = e.get("key") or {}
+        if all(str(key.get(f, "?")) == str(v) for f, v in where.items()):
+            out.append(e)
+    return out

@@ -149,6 +149,68 @@ def report_dict(records: list[dict]) -> dict:
     }
 
 
+def _track_of(rec: dict, by_id: dict) -> int:
+    """The root ancestor's id — one Perfetto track per root span, so
+    time-enclosure nesting on a track reproduces span parentage exactly
+    (spans of one thread strictly nest; unrelated roots never share a
+    track). An orphaned parent id (truncated trace) roots its subtree."""
+    seen = set()
+    cur = rec
+    while True:
+        parent = cur.get("parent")
+        if parent is None or parent not in by_id or parent in seen:
+            return cur["id"]
+        seen.add(parent)
+        cur = by_id[parent]
+
+
+def to_chrome(records: list[dict]) -> dict:
+    """Chrome trace-event JSON from obs records — opens in Perfetto /
+    chrome://tracing, so ring-hop and batch-serve timelines are browsable
+    instead of grep-able.
+
+    Spans become complete ("X") events with microsecond ts/dur; events
+    become thread-scoped instants ("i"). Span ids and parent ids ride in
+    ``args`` so tooling can verify nesting against the source parentage
+    (the CI chrome smoke does).
+    """
+    spans = _spans(records)
+    by_id = {r["id"]: r for r in spans if "id" in r}
+    events = []
+    for r in spans:
+        args = dict(r.get("attrs") or {})
+        args["span_id"] = r.get("id")
+        args["parent"] = r.get("parent")
+        if "error" in r:
+            args["error"] = r["error"]
+        events.append({
+            "ph": "X", "cat": "span", "name": r.get("name", "?"),
+            "ts": r.get("ts", 0.0) * 1e6,
+            "dur": max(r.get("dur", 0.0), 0.0) * 1e6,
+            "pid": r.get("pid", 0), "tid": _track_of(r, by_id),
+            "args": args,
+        })
+    for r in records:
+        if r.get("kind") != "event":
+            continue
+        parent = by_id.get(r.get("parent"))
+        events.append({
+            "ph": "i", "s": "t", "cat": "event", "name": r.get("name", "?"),
+            "ts": r.get("ts", 0.0) * 1e6,
+            "pid": r.get("pid", 0),
+            "tid": _track_of(parent, by_id) if parent else r.get("id", 0),
+            "args": dict(r.get("attrs") or {}),
+        })
+    events.sort(key=lambda e: e["ts"])
+    # Name each process track with its host (metadata rows sort first by
+    # convention; Perfetto accepts them anywhere).
+    meta = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": f"{host} (pid {pid})"}}
+            for pid, host in sorted(
+                {(r.get("pid", 0), r.get("host", "?")) for r in records})]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def render(rep: dict) -> str:
     """Text tables of :func:`report_dict` output for terminal reading."""
     lines = []
