@@ -47,20 +47,47 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mpi_and_open_mp_tpu.obs import ledger  # noqa: E402
 
-#: (record field, direction) — checked whenever the field is present on
-#: the candidate AND at least one baseline record. All are steady-state /
-#: differenced numbers (RTT-cancelled), so the noise floor can be tight.
+#: Record fields to judge — checked whenever the field is present on
+#: the candidate AND at least one baseline record. Throughput numbers
+#: are steady-state / differenced (RTT-cancelled); the serve latency
+#: percentiles are wall-clock but CPU-mesh-stable (the daemon phase has
+#: no device RTT in its latency path on the CI runner). Directions come
+#: from :func:`direction_for` — keyed off the metric NAME, so a new
+#: bench field gets the right polarity by naming convention instead of
+#: silently defaulting to higher-is-better.
 WATCH_FIELDS = (
-    ("value", "higher"),
-    ("sharded_steady_cups", "higher"),
-    ("batched_cups", "higher"),
-    ("batched_steady_cups", "higher"),
-    ("batched_requests_per_sec", "higher"),
-    ("attention_32k_causal_tflops", "higher"),
-    ("attention_32k_grad_tflops", "higher"),
-    ("attention_32k_causal_sec", "lower"),
-    ("attention_32k_grad_sec", "lower"),
+    "value",
+    "sharded_steady_cups",
+    "batched_cups",
+    "batched_steady_cups",
+    "batched_requests_per_sec",
+    "attention_32k_causal_tflops",
+    "attention_32k_grad_tflops",
+    "attention_32k_causal_sec",
+    "attention_32k_grad_sec",
+    "serve_requests_per_sec",
+    "serve_p50_latency_s",
+    "serve_p99_latency_s",
 )
+
+
+def direction_for(field: str) -> str:
+    """Judging polarity for a watched metric name.
+
+    Rates (``*per_sec*``, ``*cups*``, ``*tflops*``) are higher-is-better
+    and take precedence — ``batched_requests_per_sec`` must NOT fall
+    through to the ``_sec`` latency rule. Durations and badness counts
+    (``*latency*``, ``*_sec``/``*_seconds``/``*_s`` suffixes, ``shed``/
+    ``degrad`` counters) are lower-is-better: a p99 that GROWS is the
+    regression. Anything unrecognised defaults to higher-is-better (the
+    historical behaviour for throughput fields).
+    """
+    if "per_sec" in field or "cups" in field or "tflops" in field:
+        return "higher"
+    if ("latency" in field or "shed" in field or "degrad" in field
+            or field.endswith(("_sec", "_seconds", "_s"))):
+        return "lower"
+    return "higher"
 
 #: Record fields carrying engine provenance, rank-compared for downgrades.
 PROVENANCE_FIELDS = ("impl", "batch_engine", "attention_engine",
@@ -123,7 +150,8 @@ def evaluate(entries: list[dict], *, n: int = 5, noise: float = 0.1,
     cand_rec = candidate.get("record") or {}
     regressions, downgrades, checked = [], [], []
 
-    for field, direction in WATCH_FIELDS:
+    for field in WATCH_FIELDS:
+        direction = direction_for(field)
         new = cand_rec.get(field)
         base_vals = [e["record"][field] for e in pool
                      if isinstance((e.get("record") or {}).get(field),

@@ -200,6 +200,70 @@ def _batched_phase(batch: int, cups_single: float) -> dict:
     return fields
 
 
+def _serve_phase(n: int) -> dict:
+    """The serving-daemon latency phase (``--serve N``): a seeded
+    mixed-shape burst of N requests through the supervised daemon
+    (``serve.daemon`` — admission control, per-bucket deadlines, the
+    guards recovery ladder), reporting throughput and latency
+    percentiles. Honesty discipline matches every other phase: EVERY
+    resolved board is gated bit-exact against the NumPy oracle before
+    the numbers are recorded, and a shed ticket must carry an explicit
+    policy reason. A chaos plan (``MOMP_CHAOS``) drives the same code
+    the soak test exercises: ``serve_fail`` faults surface here as
+    ``serve_degraded``/``serve_retries``, a ``preempt`` plan raises
+    Preempted through main()'s exit-75 contract.
+    """
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
+    from mpi_and_open_mp_tpu.serve.queue import DONE
+
+    policy = ServePolicy(max_batch=8, max_depth=max(64, 2 * n),
+                         max_wait_s=0.005)
+    daemon = ServingDaemon(policy)
+    rng = np.random.default_rng(48)
+    shapes = ((48, 48), (64, 64))
+    steps = (4, 8)
+    t0 = time.perf_counter()
+    for i in range(n):
+        ny, nx = shapes[i % len(shapes)]
+        daemon.submit((rng.random((ny, nx)) < 0.3).astype(np.uint8),
+                      steps[i % len(steps)])
+    daemon.serve()  # Preempted propagates: the exit-75 contract holds
+    wall = time.perf_counter() - t0
+    s = daemon.summary()
+
+    bad = 0
+    for t in daemon.queue.tickets():
+        if t.state != DONE:
+            continue
+        ref = np.asarray(t.board).copy()
+        for _ in range(t.steps):
+            ref = life_step_numpy(ref)
+        if not np.array_equal(t.result, ref):
+            bad += 1
+    fields = {
+        "serve_daemon_requests": s["requests"],
+        "serve_admitted": s["requests"] - s["shed_reasons"].get(
+            "queue-depth", 0) - s["shed_reasons"].get("padding-waste", 0),
+        "serve_resolved": s["resolved"],
+        "serve_shed": s["shed"],
+        "serve_shed_reasons": s["shed_reasons"],
+        "serve_degraded": s["degraded"],
+        "serve_retries": s["retries"],
+        "serve_daemon_batches": s["batches"],
+        "serve_daemon_engines": s["engines"],
+        "serve_requests_per_sec": (round(s["resolved"] / wall, 2)
+                                   if wall > 0 else None),
+        "serve_p50_latency_s": s["p50_latency_s"],
+        "serve_p99_latency_s": s["p99_latency_s"],
+        "serve_daemon_parity": bad == 0,
+    }
+    if bad:
+        fields["serve_daemon_error"] = (
+            f"parity check failed on {bad} resolved boards")
+    return fields
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--board", type=int, default=None, metavar="N",
@@ -222,6 +286,14 @@ def main(argv=None) -> int:
                     "life_run_vmem_batch) plus a serve-layer bucketing "
                     "demo, reporting aggregate batched_cups / requests "
                     "per sec on the JSON line (runs on every backend)")
+    ap.add_argument("--serve", type=int, default=0, metavar="N",
+                    help="also run the SERVING-DAEMON phase: a seeded "
+                    "mixed-shape burst of N requests through the "
+                    "supervised daemon (serve.daemon — admission control, "
+                    "deadline flushes, recovery ladder), reporting "
+                    "serve_requests_per_sec and p50/p99 latency plus "
+                    "shed/degrade counts on the JSON line (runs on every "
+                    "backend; honors MOMP_CHAOS)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="write obs span/event JSONL here (sets MOMP_TRACE; "
                     "summarise with analysis/trace_report.py). The timed "
@@ -463,6 +535,25 @@ def _bench(args, state) -> int:
             except Exception as e:
                 batched = {"batch": args.batch,
                            "batched_error": f"{type(e).__name__}: {e}"[:200]}
+
+    # Serving-daemon phase (opt-in via --serve N): latency percentiles
+    # and shed/degrade accounting from the supervised daemon. A failure
+    # costs its fields, never the bench line — EXCEPT a preemption
+    # (signal or chaos plan), which follows the global exit-75 contract.
+    served = {}
+    if args.serve:
+        from mpi_and_open_mp_tpu.robust.preempt import Preempted
+
+        state["phase"] = "serve"
+        with obs_trace.span("bench.phase", phase="serve"):
+            try:
+                served = _serve_phase(args.serve)
+            except Preempted:
+                raise
+            except Exception as e:
+                served = {"serve_daemon_requests": args.serve,
+                          "serve_daemon_error":
+                          f"{type(e).__name__}: {e}"[:200]}
 
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device
@@ -742,6 +833,7 @@ def _bench(args, state) -> int:
         **({"recovered": recovered} if recovered else {}),
         **ckpt_fields,
         **batched,
+        **served,
         **sharded,
         **prof_fields,
         **trace_fields,

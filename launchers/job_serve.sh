@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Fault-tolerant serving drain — the queueable (tpu_queue_loop.sh) form
+# of the daemon cycle, replacing the reference's PBS qsub-requeue
+# workflow (docs/MIGRATION.md): the first pass admits a mixed-shape
+# request burst and drains it through serve.daemon; a preemption
+# (scheduler SIGTERM, or MOMP_CHAOS preempt=K) finishes the in-flight
+# batch, checkpoints the pending queue (crash-atomic CRC state file),
+# and exits 75 — the queue loop keeps this script queued, and the NEXT
+# pass finds the checkpoint and resumes it, so no admitted ticket is
+# ever dropped across passes. Idempotent by design: rerun until exit 0.
+#
+# Usage:
+#   launchers/job_serve.sh [--requests=N] [--max-batch=B] [--shapes=S]
+#                          [--checkpoint=PATH] [--seed=K]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REQUESTS=64
+MAXBATCH=8
+SHAPES=48x48,64x64
+CKPT=/tmp/momp_serve_queue.state
+SEED=0
+for arg in "$@"; do
+  case "$arg" in
+    --requests=*)   REQUESTS="${arg#*=}" ;;
+    --max-batch=*)  MAXBATCH="${arg#*=}" ;;
+    --shapes=*)     SHAPES="${arg#*=}" ;;
+    --checkpoint=*) CKPT="${arg#*=}" ;;
+    --seed=*)       SEED="${arg#*=}" ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+if [ -f "$CKPT" ]; then
+  echo "serve checkpoint $CKPT exists; resuming drained tickets" >&2
+  python -m mpi_and_open_mp_tpu.serve.daemon \
+    --requests 0 --resume --checkpoint "$CKPT" --verify
+else
+  python -m mpi_and_open_mp_tpu.serve.daemon \
+    --requests "$REQUESTS" --shapes "$SHAPES" --max-batch "$MAXBATCH" \
+    --seed "$SEED" --checkpoint "$CKPT" --verify
+fi
+# Only reached on a clean drain (set -e; a preempted pass exits 75
+# above): drop the consumed checkpoint so the next invocation starts a
+# fresh burst instead of re-serving already-resolved tickets.
+rm -f "$CKPT"

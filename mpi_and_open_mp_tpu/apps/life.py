@@ -50,6 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(batched LifeSim; needs --layout serial, excludes "
                         "snapshots/checkpoints/resume). The elapsed line "
                         "then covers B boards' worth of updates")
+    p.add_argument("--serve", type=int, default=0, metavar="N",
+                   help="serving mode: push N copies of the cfg board "
+                        "through the fault-tolerant daemon (serve.daemon: "
+                        "admission, bucket deadlines, retry/degrade "
+                        "ladder). SIGTERM drains the in-flight batch, "
+                        "checkpoints the queue under --checkpoint-dir, and "
+                        "exits 75; --resume restores it. Prints the drain "
+                        "wall seconds on the times.txt contract (first-"
+                        "dispatch compile included — serving pays it too). "
+                        "Needs --layout serial; --batch B caps the bucket "
+                        "(default 8); excludes --outdir")
     p.add_argument("--outdir", default=None,
                    help="write VTK snapshots here (default: no saves)")
     p.add_argument("--times-file", default=None,
@@ -118,6 +129,57 @@ def make_mesh(args):
     return None  # LifeSim default: all devices
 
 
+def _serve(args, cfg, parser) -> int:
+    """``--serve N``: the cfg board as N daemon requests.
+
+    The times.txt line is the queue drain wall seconds; the service
+    summary (resolved/shed/degraded, p99) goes to stderr so the
+    reference harness still sees exactly one stdout number. Preemption
+    follows the app contract: checkpoint (when ``--checkpoint-dir`` is
+    set), stderr note, exit 75 for the queue loop's requeue.
+    """
+    from mpi_and_open_mp_tpu.obs import trace
+    from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
+
+    ckpt = (os.path.join(args.checkpoint_dir, "serve_queue.state")
+            if args.checkpoint_dir else None)
+    policy = ServePolicy(max_batch=args.batch or 8,
+                         max_depth=max(64, 2 * args.serve))
+    if args.resume:
+        if not ckpt:
+            parser.error("--serve --resume needs --checkpoint-dir")
+        try:
+            daemon = ServingDaemon.resume(ckpt, policy)
+        except ValueError as e:
+            print(f"--serve --resume: {e}", file=sys.stderr)
+            return 2
+        print(f"resuming {daemon.queue.depth()} queued tickets from "
+              f"{ckpt}", file=sys.stderr)
+    else:
+        daemon = ServingDaemon(policy, checkpoint_path=ckpt)
+    board = cfg.board()
+    for _ in range(args.serve):
+        daemon.submit(board, cfg.steps)
+    t0 = time.perf_counter()
+    try:
+        with trace.span("life.serve", cfg=os.path.basename(args.cfg),
+                        requests=args.serve, steps=cfg.steps):
+            daemon.serve()
+    except Preempted as e:
+        print(f"{e} -- requeue with --serve --resume", file=sys.stderr)
+        return EXIT_PREEMPTED
+    elapsed = time.perf_counter() - t0
+    if is_primary():
+        print(f"{elapsed:.6f}")
+        if args.times_file:
+            append_times_txt(args.times_file, elapsed)
+        s = daemon.summary()
+        print(f"served {s['resolved']}/{s['requests']} "
+              f"(shed {s['shed']}, degraded {s['degraded']}, "
+              f"p99 {s['p99_latency_s']}s)", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -129,6 +191,22 @@ def main(argv=None) -> int:
     from mpi_and_open_mp_tpu.obs import trace
 
     cfg = load_config(args.cfg)
+    serve_ckpt = (os.path.join(args.checkpoint_dir, "serve_queue.state")
+                  if args.checkpoint_dir else None)
+    if args.serve or (args.resume and serve_ckpt
+                      and os.path.exists(serve_ckpt)):
+        # Serving mode is its own driver: the daemon owns batching,
+        # retries, and the queue checkpoint — the VTK path serialises
+        # one simulation, so it's excluded at the CLI edge like --batch.
+        # A bare --resume over a serve-queue checkpoint re-enters here
+        # too (a requeued job must drain its tickets, not roll back to
+        # an Orbax snapshot and silently drop them).
+        if args.layout != "serial":
+            parser.error("--serve needs --layout serial "
+                         "(a bucket is one single-program dispatch)")
+        if args.outdir:
+            parser.error("--serve is a serving mode: drop --outdir")
+        return _serve(args, cfg, parser)
     if args.batch:
         # Batched throughput mode maps straight onto the batched LifeSim
         # contract (models/life.py): serial layout only, and the VTK /

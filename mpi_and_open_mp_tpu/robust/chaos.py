@@ -29,7 +29,15 @@ Tokens:
 ``preempt=<step>``
     Raise :class:`~mpi_and_open_mp_tpu.robust.preempt.SimulatedPreemption`
     when a ``LifeSim.run`` crosses global step ``<step>`` (after flushing
-    a checkpoint when one is configured) — the SIGTERM rehearsal.
+    a checkpoint when one is configured) — the SIGTERM rehearsal. The
+    serving daemon (``serve.daemon``) reads the same token at BATCH
+    granularity: its supervised loop preempts after dispatching
+    ``<step>`` batches, checkpoint flushed, same exit-75 contract.
+``serve_fail=<k>``
+    Fail the first ``<k>`` serve-daemon batch dispatches at their
+    primary engine (:func:`take_serve_fault` consumes the budget) — the
+    mid-queue fault that drives the daemon's retry/degrade ladder in the
+    chaos soak.
 ``seed=<int>``
     Seed for corrupted-value generation (default 0).
 ``noguard``
@@ -67,6 +75,8 @@ class FaultPlan:
     preempt_step: int | None = None
     guard: bool = True
     preempt_fired: bool = False  # in-process refire latch
+    serve_fail: int = 0  # total serve-dispatch faults to inject
+    serve_failed: int = 0  # runtime count consumed so far
 
     @classmethod
     def parse(cls, raw: str) -> "FaultPlan":
@@ -89,6 +99,10 @@ class FaultPlan:
                         raise ValueError("negative delay")
                 elif key == "preempt":
                     plan.preempt_step = int(val)
+                elif key == "serve_fail":
+                    plan.serve_fail = int(val)
+                    if plan.serve_fail < 0:
+                        raise ValueError("negative serve_fail")
                 elif key == "seed":
                     plan.seed = int(val)
                 elif key == "noguard" and not val:
@@ -214,6 +228,21 @@ def corrupt_ghost(ghost, spec):
         return jnp.zeros_like(ghost)
     val = int(np.random.default_rng(seed).integers(2, 200))
     return jnp.full_like(ghost, val)
+
+
+def take_serve_fault() -> bool:
+    """Consume one serve-dispatch fault from the plan's ``serve_fail``
+    budget: ``True`` means "this dispatch must fail" (the daemon's
+    primary-engine thunk raises). Stateful like the preemption latch —
+    each call that returns ``True`` spends one fault, so the first ``k``
+    dispatches fail and every later one runs clean. ``False`` whenever no
+    plan is active or injection is :func:`suppressed` (recovery
+    re-dispatches run clean by construction)."""
+    plan = active_plan()
+    if plan is None or plan.serve_failed >= plan.serve_fail:
+        return False
+    plan.serve_failed += 1
+    return True
 
 
 def dispatch_delay() -> float:

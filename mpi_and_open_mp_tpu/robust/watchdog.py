@@ -21,10 +21,12 @@ Two hard rules, inherited from the relay's operational history
 from __future__ import annotations
 
 import dataclasses
+import random
 import subprocess
 import sys
 import tempfile
 import time
+from typing import Iterator
 
 
 def probe_once(timeout_s: float) -> tuple[bool, str]:
@@ -55,10 +57,44 @@ def probe_once(timeout_s: float) -> tuple[bool, str]:
         return False, f"probe exit {rc}" + tail()
 
 
-def backoff_schedule(n: int, base_s: float = 2.0,
-                     cap_s: float = 60.0) -> list[float]:
-    """``n`` capped-exponential waits: base, 2·base, 4·base, ... ≤ cap."""
-    return [min(cap_s, base_s * (2 ** i)) for i in range(max(0, n))]
+def backoff(base_s: float = 2.0, cap_s: float = 60.0, *,
+            jitter: float = 0.0, seed: int | None = None,
+            ) -> Iterator[float]:
+    """Capped-exponential waits as a PURE generator: base, 2·base, 4·base,
+    ... ≤ cap, each wait scaled by a seeded jitter factor drawn uniformly
+    from ``[1 - jitter, 1]``.
+
+    The jitter is the thundering-herd guard: when ``tpu_queue_loop.sh``
+    requeues several preempted jobs at once, identical schedules would
+    march every retry back onto the single-tenant relay in lockstep —
+    seeded desynchronisation spreads them while staying reproducible
+    (same seed, same schedule; the tests assert the sequence without
+    sleeping). ``jitter=0`` (the default) is the exact legacy schedule.
+    The generator never sleeps and never ends — consumers take as many
+    waits as their attempt budget allows.
+    """
+    if not 0.0 <= jitter <= 1.0:
+        raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+    rng = random.Random(seed)
+    i = 0
+    while True:
+        wait = min(cap_s, base_s * (2 ** i))
+        if jitter:
+            wait *= 1.0 - jitter * rng.random()
+        yield wait
+        # Past the cap the exponent no longer matters; freezing it keeps
+        # the generator truly unbounded (no overflow at absurd i).
+        if base_s * (2 ** i) < cap_s:
+            i += 1
+
+
+def backoff_schedule(n: int, base_s: float = 2.0, cap_s: float = 60.0,
+                     *, jitter: float = 0.0,
+                     seed: int | None = None) -> list[float]:
+    """The first ``n`` waits of :func:`backoff` as a list (legacy shape;
+    ``jitter=0`` keeps the original deterministic schedule)."""
+    gen = backoff(base_s, cap_s, jitter=jitter, seed=seed)
+    return [next(gen) for _ in range(max(0, n))]
 
 
 @dataclasses.dataclass
@@ -76,13 +112,17 @@ class ProbeResult:
 
 def probe_devices(timeout_s: float, attempts: int = 1,
                   backoff_s: float = 2.0, cap_s: float = 60.0,
-                  probe=probe_once, sleep=time.sleep) -> ProbeResult:
+                  probe=probe_once, sleep=time.sleep, *,
+                  jitter: float = 0.0,
+                  seed: int | None = None) -> ProbeResult:
     """Probe device discovery up to ``attempts`` times with bounded
-    exponential backoff between failures. ``probe``/``sleep`` are
-    injectable for tests. Never raises: exhaustion is a normal outcome
-    the caller answers with CPU degradation, not an exception."""
+    exponential backoff between failures (optionally seeded-jittered —
+    see :func:`backoff`). ``probe``/``sleep`` are injectable for tests.
+    Never raises: exhaustion is a normal outcome the caller answers with
+    CPU degradation, not an exception."""
     attempts = max(1, int(attempts))
-    waits = backoff_schedule(attempts - 1, backoff_s, cap_s)
+    waits = backoff_schedule(attempts - 1, backoff_s, cap_s,
+                             jitter=jitter, seed=seed)
     why = ""
     waited = 0.0
     for a in range(attempts):

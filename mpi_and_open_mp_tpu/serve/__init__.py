@@ -30,3 +30,16 @@ from mpi_and_open_mp_tpu.serve.batcher import (  # noqa: F401
     bucket_batch_size,
     retrace_counts,
 )
+from mpi_and_open_mp_tpu.serve.policy import (  # noqa: F401
+    SHED_DEPTH,
+    SHED_DISPATCH,
+    SHED_PADDING,
+    SHED_REASONS,
+    SHED_TIMEOUT,
+    ServePolicy,
+)
+from mpi_and_open_mp_tpu.serve.queue import (  # noqa: F401
+    ServeQueue,
+    Ticket,
+)
+from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon  # noqa: F401

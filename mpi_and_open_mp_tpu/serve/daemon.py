@@ -1,0 +1,446 @@
+"""Supervised serving daemon: deadline scheduling + recovery ladder.
+
+The always-on layer over the ``serve`` batching library. The reference's
+serving story is one workload per ``mpirun`` launch and a PBS queue that
+requeues the whole job on any failure; here a single process keeps a
+bounded admission-controlled queue (``serve.queue``), flushes shape
+buckets when they fill OR when their oldest ticket hits the policy
+deadline (padding waste traded against p99 — a bucket that never fills
+still flushes at ``max_wait_s``), and wraps every batch dispatch in a
+supervision envelope so one poisoned request or wedged engine cannot
+take the process down:
+
+* **Engine ladder** — ``robust.guards.with_fallback`` over the batched
+  native path → the vmapped XLA path → the NumPy oracle; a self-healed
+  dispatch carries the ``:recovered`` provenance suffix on every ticket
+  it resolved and lands in the process recovery log (``bench.py``
+  publishes it — a silently degraded batch would launder a fault into a
+  clean-looking artifact).
+* **Bounded retry** — a full-ladder failure retries behind the
+  ``robust.watchdog`` capped-exponential backoff with seeded jitter,
+  never past ``max_retries`` or any member ticket's end-to-end timeout;
+  exhaustion sheds the chunk with an explicit reason instead of looping.
+* **Preemption** — SIGTERM/SIGINT land as a flag checked between batch
+  dispatches (``robust.preempt``): the in-flight batch completes, the
+  pending queue snapshots through the crash-atomic CRC state checkpoint
+  (``utils.checkpoint.save_state``), and :class:`Preempted` propagates so
+  drivers exit 75 (EX_TEMPFAIL) for the ``tpu_queue_loop.sh`` requeue;
+  ``--resume`` restores every drained ticket, so an admitted request is
+  never silently dropped. ``MOMP_CHAOS preempt=<k>`` rehearses the same
+  path after ``k`` dispatched batches, and ``serve_fail=<k>`` drives the
+  ladder mid-queue.
+
+Every admission, shed, retry, degrade, and drain decision emits ``obs``
+spans/events and metrics (``serve.*``), so a bench line or a CI soak can
+assert the full accounting: requests == resolved + shed, always.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+import time
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.robust import chaos, guards, watchdog
+from mpi_and_open_mp_tpu.robust.preempt import (
+    EXIT_PREEMPTED, Preempted, SimulatedPreemption, flush_on_signal)
+from mpi_and_open_mp_tpu.serve import policy as policy_mod
+from mpi_and_open_mp_tpu.serve.batcher import bucket_batch_size
+from mpi_and_open_mp_tpu.serve.policy import ServePolicy, percentile
+from mpi_and_open_mp_tpu.serve.queue import DONE, SHED, ServeQueue, Ticket
+from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
+
+
+class ServingDaemon:
+    """One supervised worker loop over a :class:`ServeQueue`.
+
+    ``clock``/``sleep`` are injectable (tests drive deadlines and backoff
+    without wall time); the default clock is monotonic — ticket
+    timestamps never cross a process boundary raw (the checkpoint
+    restores them against the resuming process's clock).
+    """
+
+    def __init__(self, policy: ServePolicy | None = None, *,
+                 checkpoint_path: str | None = None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.policy = policy or ServePolicy()
+        self.queue = ServeQueue(self.policy)
+        self.checkpoint_path = checkpoint_path
+        self._clock = clock
+        self._sleep = sleep
+        self._batches = 0
+        self._retries = 0
+        self._degraded = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, board: np.ndarray, steps: int) -> Ticket:
+        """Admit (or reject-with-reason) one request; see
+        :meth:`ServeQueue.submit`."""
+        return self.queue.submit(board, steps, self._clock())
+
+    @classmethod
+    def resume(cls, checkpoint_path: str,
+               policy: ServePolicy | None = None, **kw) -> "ServingDaemon":
+        """A daemon whose queue starts from a drain checkpoint. Every
+        pending ticket of the snapshot is re-admitted unconditionally
+        (admission applies at the door, not to already-accepted work).
+        Raises ``ValueError`` on a missing/corrupt/foreign checkpoint."""
+        from mpi_and_open_mp_tpu.obs import trace
+
+        state = checkpoint_mod.restore_state(checkpoint_path)
+        daemon = cls(policy, checkpoint_path=checkpoint_path, **kw)
+        restored = daemon.queue.restore(state, daemon._clock())
+        trace.event("serve.resume", tickets=len(restored))
+        return daemon
+
+    # -- the supervised loop ----------------------------------------------
+
+    def serve(self, *, watch_signals: bool = True,
+              idle_tick_s: float = 0.005) -> None:
+        """Dispatch until every admitted ticket is terminal. Raises
+        :class:`Preempted` (after checkpointing the queue) on SIGTERM/
+        SIGINT or a chaos-plan preemption; anything else runs to a fully
+        drained queue."""
+        with flush_on_signal(watch_signals) as watch:
+            while True:
+                dispatched = self.pump(watch=watch)
+                if not self.queue.pending():
+                    return
+                if dispatched == 0:
+                    self._check_interrupts(watch)
+                    horizon = self.queue.next_deadline()
+                    wait = idle_tick_s
+                    if horizon is not None:
+                        wait = max(1e-4, horizon - self._clock())
+                    self._sleep(wait)
+
+    def pump(self, now: float | None = None, *, drain: bool = False,
+             watch=None) -> int:
+        """Dispatch every currently-due chunk (all of them when
+        ``drain``); returns the number of batches dispatched. Interrupt
+        flags are honored BETWEEN chunk dispatches — an in-flight batch
+        always completes (the drain half of the preemption contract)."""
+        now = self._clock() if now is None else now
+        n = 0
+        for chunk in self.queue.due_chunks(now, drain=drain):
+            self._check_interrupts(watch)
+            self._dispatch_chunk(chunk)
+            n += 1
+        return n
+
+    def drain(self) -> None:
+        """Flush everything pending regardless of deadlines (shutdown
+        path and tests)."""
+        while self.queue.pending():
+            self.pump(drain=True)
+
+    # -- internals ---------------------------------------------------------
+
+    def _check_interrupts(self, watch) -> None:
+        if watch is not None and watch.fired is not None:
+            self._preempt(signum=watch.fired)
+        plan = chaos.active_plan()
+        if (plan is not None and plan.preempt_pending(0)
+                and self._batches >= plan.preempt_step):
+            plan.preempt_fired = True
+            self._preempt(simulated=True)
+
+    def _preempt(self, signum: int | None = None,
+                 simulated: bool = False) -> None:
+        """Checkpoint the pending queue and stop. The drain decision is
+        observable: a ``serve.drain`` event with the batch/pending counts
+        and the checkpoint path rides the trace stream."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        path = None
+        if self.checkpoint_path:
+            checkpoint_mod.save_state(
+                self.checkpoint_path, self.queue.snapshot())
+            path = self.checkpoint_path
+        metrics.inc("serve.preempted")
+        trace.event("serve.drain", batches=self._batches,
+                    pending=self.queue.depth(), checkpoint=path or "")
+        cls = SimulatedPreemption if simulated else Preempted
+        raise cls(self._batches, checkpoint=path, signum=signum)
+
+    def _validator(self, stack_shape: tuple):
+        def ok(out) -> bool:
+            a = np.asarray(out)
+            return a.shape == stack_shape and bool((a <= 1).all())
+
+        return ok
+
+    def _engines(self, stack: np.ndarray, steps: int):
+        """The graceful-degradation ladder for one padded chunk, ranked:
+        the batched native path (Pallas/VMEM on TPU, vmapped XLA off it),
+        then the always-compilable vmapped XLA bit engine, then the NumPy
+        oracle — the one engine that needs no device at all. Fallback
+        engines run under ``chaos.suppressed()`` so a recovery dispatch
+        cannot be re-failed by the fault that triggered it."""
+        import jax
+
+        from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+        path = pallas_life.native_path_batch(
+            stack.shape, on_tpu=jax.default_backend() == "tpu")
+
+        def native():
+            import jax.numpy as jnp
+
+            if chaos.take_serve_fault():
+                raise RuntimeError("chaos: injected serve dispatch fault")
+            return np.asarray(
+                pallas_life.life_run_vmem_batch(jnp.asarray(stack), steps))
+
+        def xla():
+            import jax.numpy as jnp
+
+            with chaos.suppressed():
+                return np.asarray(
+                    bitlife.life_run_bits_xla_batch(jnp.asarray(stack),
+                                                    steps))
+
+        def oracle():
+            from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+
+            with chaos.suppressed():
+                out = np.array(stack, copy=True)
+                for b in range(out.shape[0]):
+                    board = out[b]
+                    for _ in range(steps):
+                        board = life_step_numpy(board)
+                    out[b] = board
+                return out
+
+        return [(f"batch:{path}", native), ("batch:xla", xla),
+                ("oracle", oracle)]
+
+    def _dispatch_chunk(self, chunk: list[Ticket]) -> None:
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        p = self.policy
+        now = self._clock()
+        # Per-request timeout, checked at the last instant before device
+        # work: a ticket that already blew its end-to-end budget (earlier
+        # retries, chaos delays, a starved bucket) sheds explicitly
+        # instead of burning a dispatch whose answer nobody is waiting
+        # for.
+        live = []
+        for t in chunk:
+            if now - t.submitted_at > p.request_timeout_s:
+                self.queue.shed_ticket(t, policy_mod.SHED_TIMEOUT, now)
+            else:
+                live.append(t)
+        if not live:
+            return
+
+        shape = live[0].board.shape
+        steps = live[0].steps
+        padded = bucket_batch_size(len(live), p.max_batch)
+        stack = np.zeros((padded, *shape), dtype=live[0].board.dtype)
+        for i, t in enumerate(live):
+            stack[i] = t.board
+        engines = self._engines(stack, steps)
+        validator = self._validator(stack.shape)
+        # One jittered backoff schedule per chunk, seeded off the lead
+        # ticket so concurrent requeued daemons desynchronise while any
+        # single run stays reproducible.
+        waits = watchdog.backoff(p.backoff_base_s, p.backoff_cap_s,
+                                 jitter=p.backoff_jitter,
+                                 seed=p.seed + live[0].id)
+        deadline = min(t.submitted_at for t in live) + p.request_timeout_s
+        attempt = 0
+        while True:
+            delay = chaos.dispatch_delay()
+            if delay:
+                self._sleep(delay)
+            try:
+                with trace.span(
+                    "serve.dispatch", shape=f"{shape[0]}x{shape[1]}",
+                    steps=steps, requests=len(live), padded=padded,
+                    attempt=attempt,
+                ):
+                    out, stamp, _notes = guards.with_fallback(
+                        engines, validator=validator)
+                break
+            except guards.FallbackExhausted as e:
+                attempt += 1
+                self._retries += 1
+                metrics.inc("serve.retries")
+                trace.event("serve.retry", attempt=attempt,
+                            notes="; ".join(e.notes)[:200])
+                now = self._clock()
+                if attempt > p.max_retries:
+                    for t in live:
+                        self.queue.shed_ticket(
+                            t, policy_mod.SHED_DISPATCH, now)
+                    return
+                wait = next(waits)
+                if now + wait > deadline:
+                    for t in live:
+                        self.queue.shed_ticket(
+                            t, policy_mod.SHED_TIMEOUT, now)
+                    return
+                self._sleep(wait)
+
+        if stamp.endswith(":recovered"):
+            # The degrade decision, on the record: aggregate count +
+            # ordered stamp in the process recovery log (what bench.py
+            # publishes as `recovered`) + a trace event via the funnel.
+            self._degraded += 1
+            metrics.inc("serve.degraded")
+            guards.record_recovery(f"serve:{stamp}")
+        now = self._clock()
+        host = np.asarray(out)[:len(live)]
+        for i, t in enumerate(live):
+            self.queue.resolve(t, host[i], stamp, now)
+        self._batches += 1
+        metrics.inc("serve.batches")
+        if padded > len(live):
+            metrics.inc("serve.padding", padded - len(live))
+
+    # -- accounting --------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The accounting the soak test and the bench line read: every
+        ticket in exactly one terminal bucket, latency percentiles over
+        the resolved set, engine/reason breakdowns."""
+        tickets = self.queue.tickets()
+        done = [t for t in tickets if t.state == DONE]
+        shed = [t for t in tickets if t.state == SHED]
+        lat = [t.latency_s for t in done]
+        return {
+            "requests": len(tickets),
+            "resolved": len(done),
+            "shed": len(shed),
+            "pending": self.queue.depth(),
+            "batches": self._batches,
+            "retries": self._retries,
+            "degraded": self._degraded,
+            "shed_reasons": dict(collections.Counter(
+                t.reason for t in shed)),
+            "engines": dict(collections.Counter(t.engine for t in done)),
+            "p50_latency_s": round(percentile(lat, 50), 6),
+            "p99_latency_s": round(percentile(lat, 99), 6),
+        }
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_and_open_mp_tpu.serve.daemon",
+        description="Fault-tolerant Life serving daemon: submit a seeded "
+        "mixed-shape burst, drain it under the supervision ladder, print "
+        "ONE JSON summary line. SIGTERM checkpoints the queue and exits "
+        "75 (EX_TEMPFAIL); --resume continues it.")
+    p.add_argument("--requests", type=int, default=32, metavar="N",
+                   help="burst size (default 32; 0 with --resume drains "
+                   "the checkpoint only)")
+    p.add_argument("--shapes", default="48x48,64x64", metavar="S",
+                   help="comma-separated NYxNX request shapes, cycled "
+                   "over the burst (default %(default)s)")
+    p.add_argument("--steps", default="4,8", metavar="K",
+                   help="comma-separated step counts, cycled (default "
+                   "%(default)s)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-depth", type=int, default=4096)
+    p.add_argument("--max-wait", type=float, default=0.02, metavar="S",
+                   help="per-bucket deadline seconds (default 0.02)")
+    p.add_argument("--timeout", type=float, default=60.0, metavar="S",
+                   help="per-request end-to-end budget (default 60)")
+    p.add_argument("--retries", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="queue drain checkpoint file (written on "
+                   "SIGTERM/preemption; required for --resume)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore drained tickets from --checkpoint "
+                   "before serving the (possibly empty) new burst")
+    p.add_argument("--verify", action="store_true",
+                   help="gate every resolved board bit-exact against the "
+                   "NumPy oracle before reporting (CI smoke)")
+    return p
+
+
+def _burst(daemon: ServingDaemon, args) -> None:
+    shapes = []
+    for tok in args.shapes.split(","):
+        ny, _, nx = tok.strip().partition("x")
+        shapes.append((int(ny), int(nx)))
+    steps = [int(s) for s in args.steps.split(",")]
+    rng = np.random.default_rng(args.seed)
+    for i in range(args.requests):
+        ny, nx = shapes[i % len(shapes)]
+        board = (rng.random((ny, nx)) < 0.3).astype(np.uint8)
+        daemon.submit(board, steps[i % len(steps)])
+
+
+def _verify(daemon: ServingDaemon) -> bool:
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+
+    for t in daemon.queue.tickets():
+        if t.state != DONE:
+            continue
+        ref = np.asarray(t.board).copy()
+        for _ in range(t.steps):
+            ref = life_step_numpy(ref)
+        if not np.array_equal(t.result, ref):
+            return False
+    return True
+
+
+def main(argv=None) -> int:
+    from mpi_and_open_mp_tpu.obs import metrics
+
+    args = build_parser().parse_args(argv)
+    if args.resume and not args.checkpoint:
+        build_parser().error("--resume requires --checkpoint")
+    policy = ServePolicy(
+        max_batch=args.max_batch, max_depth=args.max_depth,
+        max_wait_s=args.max_wait, request_timeout_s=args.timeout,
+        max_retries=args.retries, seed=args.seed)
+    rec: dict = {"daemon": "serve", "resume": bool(args.resume)}
+    try:
+        if args.resume:
+            daemon = ServingDaemon.resume(args.checkpoint, policy)
+            rec["resumed_tickets"] = daemon.queue.depth()
+        else:
+            daemon = ServingDaemon(policy, checkpoint_path=args.checkpoint)
+        _burst(daemon, args)
+        t0 = time.perf_counter()
+        daemon.serve()
+        wall = time.perf_counter() - t0
+    except Preempted as e:
+        rec.update({"preempted": True, "resume": True,
+                    "batches": e.step, "checkpoint": e.checkpoint,
+                    **{k: v for k, v in daemon.summary().items()
+                       if k != "engines"}})
+        print(json.dumps(rec))
+        return EXIT_PREEMPTED
+    except Exception as e:  # noqa: BLE001 — the line IS the contract
+        rec["error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(rec))
+        return 1
+    rec.update({"preempted": False, "wall_sec": round(wall, 4),
+                **daemon.summary()})
+    if rec["resolved"] and wall > 0:
+        rec["requests_per_sec"] = round(rec["resolved"] / wall, 2)
+    if args.verify:
+        rec["verified"] = _verify(daemon)
+    if metrics.metrics_on():
+        rec["metrics"] = metrics.snapshot()
+    print(json.dumps(rec))
+    if args.verify and not rec.get("verified"):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""Serving policy: admission control, load shedding, deadline budgets.
+
+Pure decision logic — no clocks, no IO, no jax — so every admission and
+shed rule is assertable in a unit test without running the daemon. The
+daemon (``serve.daemon``) owns the side effects; this module owns the
+numbers they are judged against.
+
+The two admission budgets guard the two resources a shape-bucketed
+server can actually exhaust:
+
+* **Depth** — pending tickets queue host memory and, at ~70 ms RTT per
+  dispatch through the relay, wall time: a queue deeper than the worker
+  can drain inside the per-request timeout is already lost, so it is
+  cheaper (and honest) to reject at the door with an explicit reason
+  than to time the request out later.
+* **Padding waste** — every bucket chunk pads its live requests up to a
+  power of two (``serve.batcher.bucket_batch_size``), so an adversarial
+  request mix can make the device spend most of its cycles advancing
+  dead zero-boards. :func:`padding_waste` estimates that fraction over
+  the whole pending set; admission rejects a request whose acceptance
+  pushes the estimate past budget.
+
+Shed reasons are closed vocabulary (the ``SHED_*`` constants): every
+rejected or abandoned ticket carries exactly one, metrics count them per
+reason (``serve.shed{reason=...}``), and the chaos soak asserts no ticket
+ever ends without either a result or one of these strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Iterable
+
+from mpi_and_open_mp_tpu.serve.batcher import bucket_batch_size
+
+#: Admission rejected: pending depth at ``max_depth``.
+SHED_DEPTH = "queue-depth"
+#: Admission rejected: estimated padding waste past ``max_padding_frac``.
+SHED_PADDING = "padding-waste"
+#: Abandoned: the ticket aged past ``request_timeout_s`` before a
+#: dispatch could resolve it (pathological shapes must not starve peers).
+SHED_TIMEOUT = "timeout"
+#: Abandoned: every engine of every retry of the recovery ladder failed.
+SHED_DISPATCH = "dispatch-failed"
+
+SHED_REASONS = (SHED_DEPTH, SHED_PADDING, SHED_TIMEOUT, SHED_DISPATCH)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePolicy:
+    """The serving daemon's knobs, one immutable bundle.
+
+    ``max_wait_s`` is the padding-vs-latency trade: a bucket that never
+    fills to ``max_batch`` still flushes once its oldest ticket has
+    waited this long, bounding p99 at the cost of a padded dispatch.
+    ``request_timeout_s`` is the end-to-end budget per ticket; the
+    retry/backoff ladder never sleeps past it. Backoff is the
+    ``robust.watchdog`` capped-exponential schedule with seeded jitter
+    (thundering-herd guard when a queue loop requeues several daemons at
+    once).
+    """
+
+    max_batch: int = 8
+    max_depth: int = 64
+    max_padding_frac: float = 0.375
+    max_wait_s: float = 0.05
+    request_timeout_s: float = 30.0
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 1.0
+    backoff_jitter: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {self.max_depth}")
+        if not 0.0 <= self.max_padding_frac <= 1.0:
+            raise ValueError(
+                f"max_padding_frac must be in [0, 1], got "
+                f"{self.max_padding_frac}")
+        for name in ("max_wait_s", "request_timeout_s", "backoff_base_s",
+                     "backoff_cap_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+
+
+def padding_waste(bucket_counts: Iterable[int], max_batch: int) -> float:
+    """Estimated dead-padding fraction of dispatching these buckets now.
+
+    Each bucket of ``r`` live requests dispatches as full ``max_batch``
+    chunks plus one remainder chunk padded to the next power of two; the
+    waste is padded slots minus live requests over padded slots. 0.0 for
+    an empty queue (nothing to dispatch wastes nothing).
+    """
+    live = padded = 0
+    for r in bucket_counts:
+        if r <= 0:
+            continue
+        live += r
+        full, rest = divmod(r, max_batch)
+        padded += full * max_batch
+        if rest:
+            padded += bucket_batch_size(rest, max_batch)
+    if padded == 0:
+        return 0.0
+    return (padded - live) / padded
+
+
+def admit(policy: ServePolicy, depth: int,
+          bucket_counts_after: Iterable[int]) -> str | None:
+    """Admission verdict for one candidate request: ``None`` to accept,
+    else the shed reason. ``depth`` is the pending count BEFORE the
+    candidate; ``bucket_counts_after`` are per-bucket pending counts
+    WITH the candidate already placed in its bucket."""
+    if depth >= policy.max_depth:
+        return SHED_DEPTH
+    if padding_waste(bucket_counts_after,
+                     policy.max_batch) > policy.max_padding_frac:
+        return SHED_PADDING
+    return None
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (``q`` in [0, 100]) — the p50/p99 the
+    bench line publishes. 0.0 on an empty list so a fully-shed run still
+    renders a line."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    if q <= 0:
+        return xs[0]
+    idx = max(0, min(len(xs) - 1, int(-(-q * len(xs) // 100)) - 1))
+    return xs[idx]

@@ -1,0 +1,242 @@
+"""Bounded, admission-controlled, checkpointable request queue.
+
+The ticket ledger of the serving daemon: every submission becomes a
+:class:`Ticket` that ends in exactly one terminal state — ``DONE`` with
+a result and an engine stamp, or ``SHED`` with an explicit reason from
+the ``serve.policy`` vocabulary. Nothing is ever silently dropped: a
+SIGTERM drain snapshots the pending tickets (payload boards, step
+counts, submission order) through the crash-atomic CRC state checkpoint
+(``utils.checkpoint.save_state``) and :meth:`ServeQueue.restore` readmits
+them unconditionally — admission control applies at the door, not to
+requests the daemon already accepted.
+
+Buckets key on ``(shape, dtype, steps)`` — one bucket is one compiled
+program's worth of same-shape work (steps being a runtime scalar, the
+split by steps exists because all boards of a stack advance together,
+not for compilation). Deadline bookkeeping lives here (oldest pending
+ticket per bucket); the policy decides when a bucket is due, the daemon
+dispatches it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.serve import policy as policy_mod
+from mpi_and_open_mp_tpu.serve.policy import ServePolicy
+
+PENDING = "pending"
+DONE = "done"
+SHED = "shed"
+
+STATE_SCHEMA = "momp-serve-queue/1"
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One request's life, admission through terminal state."""
+
+    id: int
+    board: np.ndarray
+    steps: int
+    submitted_at: float
+    state: str = PENDING
+    result: np.ndarray | None = None
+    reason: str | None = None  # shed reason (policy.SHED_*)
+    engine: str | None = None  # provenance stamp of the resolving dispatch
+    resolved_at: float | None = None
+    resumed: bool = False  # restored from a drain checkpoint
+
+    @property
+    def bucket_key(self) -> tuple:
+        return (self.board.shape, self.board.dtype.str, self.steps)
+
+    @property
+    def latency_s(self) -> float | None:
+        """Submission-to-terminal seconds (``None`` while pending)."""
+        if self.resolved_at is None:
+            return None
+        return self.resolved_at - self.submitted_at
+
+
+class ServeQueue:
+    """Ticket store + admission gate. All times come from the caller
+    (``now`` arguments) so tests drive deadlines with a fake clock."""
+
+    def __init__(self, policy: ServePolicy | None = None):
+        self.policy = policy or ServePolicy()
+        self._tickets: dict[int, Ticket] = {}
+        self._next_ticket = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, board: np.ndarray, steps: int, now: float) -> Ticket:
+        """Admit or reject one request; ALWAYS returns a ticket. A
+        rejected ticket is already terminal (``SHED`` with the admission
+        reason) so callers account for every submission the same way."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        board = np.asarray(board)
+        if board.ndim != 2:
+            raise ValueError(
+                f"submit: one 2D board per request, got shape {board.shape}")
+        steps = int(steps)
+        if steps < 0:
+            raise ValueError(f"submit: steps must be >= 0, got {steps}")
+        t = Ticket(self._next_ticket, board, steps, float(now))
+        self._next_ticket += 1
+        counts = self._bucket_counts()
+        counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
+        reason = policy_mod.admit(
+            self.policy, self.depth(), counts.values())
+        self._tickets[t.id] = t
+        metrics.inc("serve.requests")
+        if reason is not None:
+            self._shed(t, reason, now)
+        else:
+            metrics.inc("serve.admitted")
+            trace.event("serve.admit", ticket=t.id,
+                        shape=f"{board.shape[0]}x{board.shape[1]}",
+                        steps=steps)
+        return t
+
+    def restore_ticket(self, board: np.ndarray, steps: int,
+                       now: float) -> Ticket:
+        """Re-admit one drained ticket from a checkpoint — NO admission
+        gate (it was already admitted once; dropping it now would break
+        the never-lose-a-ticket contract). The deadline clock restarts at
+        ``now``: monotonic timestamps don't survive a process boundary."""
+        from mpi_and_open_mp_tpu.obs import metrics
+
+        t = Ticket(self._next_ticket, np.asarray(board), int(steps),
+                   float(now), resumed=True)
+        self._next_ticket += 1
+        self._tickets[t.id] = t
+        metrics.inc("serve.requests")
+        metrics.inc("serve.admitted")
+        metrics.inc("serve.resumed_tickets")
+        return t
+
+    # -- queries -----------------------------------------------------------
+
+    def depth(self) -> int:
+        return sum(1 for t in self._tickets.values() if t.state == PENDING)
+
+    def pending(self) -> list[Ticket]:
+        """Pending tickets in submission order (dict preserves it)."""
+        return [t for t in self._tickets.values() if t.state == PENDING]
+
+    def tickets(self) -> list[Ticket]:
+        """Every ticket ever submitted, in submission order."""
+        return list(self._tickets.values())
+
+    def _bucket_counts(self) -> dict[tuple, int]:
+        counts: dict[tuple, int] = {}
+        for t in self.pending():
+            counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
+        return counts
+
+    def buckets(self) -> dict[tuple, list[Ticket]]:
+        """Pending tickets grouped by bucket, submission order inside."""
+        out: dict[tuple, list[Ticket]] = {}
+        for t in self.pending():
+            out.setdefault(t.bucket_key, []).append(t)
+        return out
+
+    def due_chunks(self, now: float, drain: bool = False) -> list[list[Ticket]]:
+        """Dispatchable chunks: every full ``max_batch`` slice of every
+        bucket, plus the remainder of any bucket whose oldest pending
+        ticket has waited ``max_wait_s`` (or everything when draining).
+        Chunks come out in oldest-ticket-first order so a starved bucket
+        is served before a fresh full one."""
+        mb = self.policy.max_batch
+        chunks: list[list[Ticket]] = []
+        for _, group in self.buckets().items():
+            due = drain or (now - group[0].submitted_at
+                            >= self.policy.max_wait_s)
+            lo = 0
+            while len(group) - lo >= mb:
+                chunks.append(group[lo:lo + mb])
+                lo += mb
+            if due and lo < len(group):
+                chunks.append(group[lo:])
+        chunks.sort(key=lambda c: c[0].id)
+        return chunks
+
+    def next_deadline(self) -> float | None:
+        """The earliest instant any bucket becomes due, or ``None`` when
+        nothing is pending — the daemon's idle-sleep horizon."""
+        oldest = [g[0].submitted_at for g in self.buckets().values()]
+        if not oldest:
+            return None
+        return min(oldest) + self.policy.max_wait_s
+
+    # -- terminal transitions ---------------------------------------------
+
+    def resolve(self, ticket: Ticket, result: np.ndarray, engine: str,
+                now: float) -> None:
+        from mpi_and_open_mp_tpu.obs import metrics
+
+        ticket.state = DONE
+        ticket.result = result
+        ticket.engine = engine
+        ticket.resolved_at = float(now)
+        metrics.inc("serve.resolved")
+        metrics.observe("serve.latency_seconds", ticket.latency_s)
+
+    def shed_ticket(self, ticket: Ticket, reason: str, now: float) -> None:
+        self._shed(ticket, reason, now)
+
+    def _shed(self, ticket: Ticket, reason: str, now: float) -> None:
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        if reason not in policy_mod.SHED_REASONS:
+            raise ValueError(f"unknown shed reason {reason!r} "
+                             f"(want one of {policy_mod.SHED_REASONS})")
+        ticket.state = SHED
+        ticket.reason = reason
+        ticket.resolved_at = float(now)
+        metrics.inc("serve.shed", reason=reason)
+        trace.event("serve.shed", ticket=ticket.id, reason=reason)
+
+    # -- checkpoint round trip --------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The pending set as a picklable tree for
+        ``utils.checkpoint.save_state`` — ticket order, payloads, step
+        counts, and the original ids (provenance: an operator can map a
+        resumed ticket back to the pre-preemption submission)."""
+        return {
+            "schema": STATE_SCHEMA,
+            "next_ticket": self._next_ticket,
+            "pending": [
+                {"id": t.id, "board": np.asarray(t.board), "steps": t.steps}
+                for t in self.pending()
+            ],
+        }
+
+    def restore(self, state: dict, now: float) -> list[Ticket]:
+        """Re-admit every pending ticket of a :meth:`snapshot` tree, in
+        its original order. Raises ``ValueError`` on a tree that isn't a
+        serve-queue snapshot (wrong schema / missing fields)."""
+        if not isinstance(state, dict) or state.get("schema") != STATE_SCHEMA:
+            raise ValueError(
+                "not a serve-queue checkpoint: schema is "
+                f"{state.get('schema') if isinstance(state, dict) else type(state)!r},"
+                f" want {STATE_SCHEMA!r}")
+        pending = state.get("pending")
+        if not isinstance(pending, list):
+            raise ValueError(
+                "serve-queue checkpoint is missing its pending list")
+        out = []
+        for item in pending:
+            try:
+                board, steps = item["board"], item["steps"]
+            except (TypeError, KeyError) as e:
+                raise ValueError(
+                    f"serve-queue checkpoint entry is malformed: {item!r}"
+                ) from e
+            out.append(self.restore_ticket(board, steps, now))
+        return out

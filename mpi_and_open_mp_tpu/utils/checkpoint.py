@@ -20,7 +20,9 @@ corrupt/partial/mismatched tree instead of an Orbax traceback.
 from __future__ import annotations
 
 import os
+import pickle
 import shutil
+import struct
 import zlib
 
 import numpy as np
@@ -130,3 +132,94 @@ def restore(path: str | os.PathLike) -> tuple[np.ndarray, int]:
                 f"(stored {want:#010x}, recomputed {got:#010x}) — "
                 "the tree is corrupt; fall back to an earlier step")
     return board, step
+
+
+# --------------------------------------------------------------------------
+# Single-file host-state checkpoints (the serving daemon's queue snapshot).
+#
+# Orbax above serialises DEVICE state (a sharded board) as a directory
+# tree; the daemon's pending-request queue is small HOST state (ticket
+# order, payload boards, bucket metadata) that must survive a SIGTERM in
+# one crash-atomic file. Frame: an ASCII magic line, an 8-byte big-endian
+# payload length, a 4-byte CRC32 of the payload, then the pickled payload.
+# ``restore_state`` validates frame, length, and CRC BEFORE unpickling, so
+# a truncated or garbage file — the tail a killed writer or a corrupt disk
+# leaves behind — raises a clean ``ValueError`` naming the failure, never
+# a pickle/struct traceback.
+
+STATE_MAGIC = b"MOMP-STATE/1\n"
+_STATE_HEADER = struct.Struct(">QI")  # payload length, CRC32
+
+
+def save_state(path: str | os.PathLike, state) -> None:
+    """Write one picklable host-state tree to ``path`` atomically (tmp
+    sibling + ``os.replace``, same discipline as :func:`save`)."""
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+
+    path = os.path.abspath(os.fspath(path))
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    blob = (STATE_MAGIC
+            + _STATE_HEADER.pack(len(payload), zlib.crc32(payload))
+            + payload)
+    with trace.span("checkpoint.state_save", path=path, bytes=len(blob)):
+        outdir = os.path.dirname(path)
+        if outdir:
+            os.makedirs(outdir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fd:
+            fd.write(blob)
+            fd.flush()
+            os.fsync(fd.fileno())
+        os.replace(tmp, path)
+    metrics.inc("checkpoint.state_saves")
+    metrics.inc("checkpoint.state_save.bytes", len(blob))
+
+
+def restore_state(path: str | os.PathLike):
+    """Read a :func:`save_state` file back, fully validated.
+
+    Raises ``ValueError`` — with the specific failure (missing file, bad
+    magic, truncated header/payload, CRC mismatch, undecodable payload) —
+    on anything short of a complete verified frame.
+    """
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+
+    path = os.path.abspath(os.fspath(path))
+    with trace.span("checkpoint.state_restore", path=path):
+        try:
+            with open(path, "rb") as fd:
+                blob = fd.read()
+        except OSError as e:
+            raise ValueError(
+                f"no readable state checkpoint at {path} "
+                f"({type(e).__name__}: {e})") from e
+        head = len(STATE_MAGIC) + _STATE_HEADER.size
+        if not blob.startswith(STATE_MAGIC):
+            raise ValueError(
+                f"state checkpoint at {path} has a bad magic header — "
+                "not a MOMP-STATE/1 file (or corrupted at offset 0)")
+        if len(blob) < head:
+            raise ValueError(
+                f"state checkpoint at {path} is truncated inside its "
+                f"header ({len(blob)} of {head} header bytes)")
+        length, want_crc = _STATE_HEADER.unpack(
+            blob[len(STATE_MAGIC):head])
+        payload = blob[head:]
+        if len(payload) != length:
+            raise ValueError(
+                f"state checkpoint at {path} is truncated: payload is "
+                f"{len(payload)} bytes, header promises {length}")
+        got_crc = zlib.crc32(payload)
+        if got_crc != want_crc:
+            raise ValueError(
+                f"state checkpoint at {path} failed its CRC "
+                f"(stored {want_crc:#010x}, recomputed {got_crc:#010x}) "
+                "— the file is corrupt")
+        try:
+            state = pickle.loads(payload)
+        except Exception as e:  # noqa: BLE001 — any unpickle failure
+            raise ValueError(
+                f"state checkpoint at {path} passed its CRC but failed "
+                f"to decode ({type(e).__name__}: {e})"[:400]) from e
+    metrics.inc("checkpoint.state_restores")
+    return state

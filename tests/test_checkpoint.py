@@ -223,6 +223,73 @@ def test_restore_corrupt_or_missing_raises_valueerror(tmp_path):
         checkpoint.restore(bad)
 
 
+def test_state_checkpoint_roundtrip_atomic(tmp_path):
+    """The single-file host-state checkpoint (the serving daemon's queue
+    snapshot): arbitrary picklable trees round-trip bit-exact, parent
+    dirs are created, and a rewrite replaces atomically."""
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    state = {"schema": "x/1", "boards": [np.arange(12).reshape(3, 4)],
+             "n": 7, "names": ("a", "b")}
+    path = tmp_path / "sub" / "queue.state"
+    checkpoint.save_state(path, state)
+    got = checkpoint.restore_state(path)
+    assert got["n"] == 7 and got["names"] == ("a", "b")
+    np.testing.assert_array_equal(got["boards"][0], state["boards"][0])
+    checkpoint.save_state(path, {"n": 8})  # overwrite in place
+    assert checkpoint.restore_state(path) == {"n": 8}
+    assert not (tmp_path / "sub" / "queue.state.tmp").exists()
+
+
+def test_state_checkpoint_truncation_fails_clean(tmp_path):
+    """The satellite regression: a state file truncated at ANY offset —
+    inside the magic, inside the length/CRC header, mid-payload, one byte
+    short — must raise a clean ValueError naming the failure, never a
+    pickle/struct traceback."""
+    import pytest
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    path = tmp_path / "q.state"
+    checkpoint.save_state(
+        path, {"pending": [{"board": np.ones((8, 8), np.uint8), "steps": 3}]})
+    blob = path.read_bytes()
+    head = len(checkpoint.STATE_MAGIC) + checkpoint._STATE_HEADER.size
+    assert len(blob) > head + 8
+    cuts = {3: "magic",  # inside the magic line
+            len(checkpoint.STATE_MAGIC) + 4: "truncated",  # inside header
+            head + (len(blob) - head) // 2: "truncated",  # mid-payload
+            len(blob) - 1: "truncated"}  # one byte short
+    for cut, expect in cuts.items():
+        trunc = tmp_path / f"cut_{cut}.state"
+        trunc.write_bytes(blob[:cut])
+        with pytest.raises(ValueError, match=expect):
+            checkpoint.restore_state(trunc)
+
+
+def test_state_checkpoint_garbage_crc_and_missing(tmp_path):
+    import pytest
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    garbage = tmp_path / "garbage.state"
+    garbage.write_bytes(b"not a checkpoint at all, just bytes\n" * 3)
+    with pytest.raises(ValueError, match="magic"):
+        checkpoint.restore_state(garbage)
+
+    path = tmp_path / "q.state"
+    checkpoint.save_state(path, {"n": 1})
+    blob = bytearray(path.read_bytes())
+    blob[-1] ^= 0xFF  # flip one payload byte: CRC must catch it
+    flipped = tmp_path / "flipped.state"
+    flipped.write_bytes(bytes(blob))
+    with pytest.raises(ValueError, match="CRC"):
+        checkpoint.restore_state(flipped)
+
+    with pytest.raises(ValueError, match="no readable"):
+        checkpoint.restore_state(tmp_path / "missing.state")
+
+
 def test_checkpoint_resume_bitfused_padded_frame(tmp_path, make_board):
     """Mid-run checkpoint/resume through the packed path on an unaligned
     board: the stored state is the PADDED frame (mirror rows included);

@@ -201,6 +201,62 @@ def test_engine_rank_tiers():
     assert rank(None) == 0 and rank("") == 0
 
 
+def test_direction_for_name_keying():
+    """Polarity comes from the metric NAME: rates are higher-is-better
+    even when they end in ``_sec``; latencies and badness counters are
+    lower-is-better."""
+    d = regression_sentinel.direction_for
+    assert d("value") == "higher"
+    assert d("batched_cups") == "higher"
+    assert d("serve_requests_per_sec") == "higher"  # NOT the _sec rule
+    assert d("batched_requests_per_sec") == "higher"
+    assert d("attention_32k_grad_tflops") == "higher"
+    assert d("attention_32k_causal_sec") == "lower"
+    assert d("serve_p50_latency_s") == "lower"
+    assert d("serve_p99_latency_s") == "lower"
+    assert d("serve_shed") == "lower"
+    assert d("serve_degraded") == "lower"
+
+
+def test_sentinel_flags_p99_inflation(tmp_path, capsys):
+    """Higher-is-WORSE: a serve p99 that grows past the noise floor must
+    fail even with every throughput field flat."""
+    entries = [_entry(100.0, ts=float(i),
+                      extra={"serve_p99_latency_s": 0.05}) for i in range(3)]
+    entries.append(_entry(100.0, ts=3.0,
+                          extra={"serve_p99_latency_s": 0.12}))
+    assert _run_main(tmp_path, entries, "--noise", "0.1") == 1
+    verdict = json.loads(capsys.readouterr().out)
+    (reg,) = verdict["regressions"]
+    assert reg["field"] == "serve_p99_latency_s"
+    assert reg["direction"] == "lower" and reg["baseline_median"] == 0.05
+    assert reg["drop"] == pytest.approx(1.4)  # (0.12-0.05)/0.05
+
+
+def test_sentinel_p99_improvement_and_rate_drop(tmp_path, capsys):
+    """Both directions, same ledger: a p99 that SHRINKS passes; a
+    requests/sec rate that drops fails under the throughput polarity."""
+    entries = [_entry(100.0, ts=float(i),
+                      extra={"serve_p99_latency_s": 0.05,
+                             "serve_requests_per_sec": 200.0})
+               for i in range(3)]
+    entries.append(_entry(100.0, ts=3.0,
+                          extra={"serve_p99_latency_s": 0.01,
+                                 "serve_requests_per_sec": 210.0}))
+    assert _run_main(tmp_path, entries) == 0
+
+    entries.append(_entry(100.0, ts=4.0,
+                          extra={"serve_p99_latency_s": 0.05,
+                                 "serve_requests_per_sec": 120.0}))
+    assert _run_main(tmp_path, entries) == 1
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    fields = {r["field"]: r for r in verdict["regressions"]}
+    assert "serve_requests_per_sec" in fields
+    assert fields["serve_requests_per_sec"]["direction"] == "higher"
+    # The shrunken p99 must not register as a regression either way.
+    assert "serve_p99_latency_s" not in fields
+
+
 # ---------------------------------------------------------------- backfill
 
 

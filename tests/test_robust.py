@@ -162,6 +162,39 @@ def test_watchdog_backoff_schedule_capped():
     assert watchdog.backoff_schedule(0) == []
 
 
+def test_watchdog_backoff_generator_jitter_seeded_and_bounded():
+    """The jittered schedule is a pure generator: seeded draws are
+    reproducible, every wait stays within [base*(1-jitter), base] of the
+    un-jittered capped-exponential value, and distinct seeds decorrelate
+    (the thundering-herd property a requeue loop of several daemons
+    needs)."""
+    import itertools
+
+    pure = [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+    assert list(itertools.islice(watchdog.backoff(1.0, 8.0), 6)) == pure
+    a = list(itertools.islice(
+        watchdog.backoff(1.0, 8.0, jitter=0.5, seed=3), 6))
+    b = list(itertools.islice(
+        watchdog.backoff(1.0, 8.0, jitter=0.5, seed=3), 6))
+    assert a == b  # seeded: same schedule every time
+    for got, base in zip(a, pure):
+        assert base * 0.5 <= got <= base
+    c = list(itertools.islice(
+        watchdog.backoff(1.0, 8.0, jitter=0.5, seed=4), 6))
+    assert c != a  # different seed, different herd slot
+    with pytest.raises(ValueError, match="jitter"):
+        next(watchdog.backoff(jitter=1.5))
+
+
+def test_watchdog_backoff_schedule_jitter_matches_generator():
+    import itertools
+
+    want = list(itertools.islice(
+        watchdog.backoff(2.0, 60.0, jitter=0.25, seed=9), 4))
+    assert watchdog.backoff_schedule(
+        4, base_s=2.0, cap_s=60.0, jitter=0.25, seed=9) == want
+
+
 def test_watchdog_probe_devices_backs_off_then_degrades():
     probes, slept = [], []
 
