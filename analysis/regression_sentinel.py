@@ -68,6 +68,8 @@ WATCH_FIELDS = (
     "serve_requests_per_sec",
     "serve_p50_latency_s",
     "serve_p99_latency_s",
+    "serve_wal_bytes",
+    "serve_wal_fsync_s",
 )
 
 
@@ -76,16 +78,19 @@ def direction_for(field: str) -> str:
 
     Rates (``*per_sec*``, ``*cups*``, ``*tflops*``) are higher-is-better
     and take precedence — ``batched_requests_per_sec`` must NOT fall
-    through to the ``_sec`` latency rule. Durations and badness counts
-    (``*latency*``, ``*_sec``/``*_seconds``/``*_s`` suffixes, ``shed``/
-    ``degrad`` counters) are lower-is-better: a p99 that GROWS is the
-    regression. Anything unrecognised defaults to higher-is-better (the
-    historical behaviour for throughput fields).
+    through to the ``_sec`` latency rule. Durations, badness counts and
+    overhead volumes (``*latency*``, ``*_sec``/``*_seconds``/``*_s``/
+    ``*_bytes`` suffixes, ``shed``/``degrad`` counters) are
+    lower-is-better: a p99 that GROWS is the regression, and so is a
+    write-ahead-journal durability tax that swells (``serve_wal_bytes``
+    volume, ``serve_wal_fsync_s`` sync stall). Anything unrecognised
+    defaults to higher-is-better (the historical behaviour for
+    throughput fields).
     """
     if "per_sec" in field or "cups" in field or "tflops" in field:
         return "higher"
     if ("latency" in field or "shed" in field or "degrad" in field
-            or field.endswith(("_sec", "_seconds", "_s"))):
+            or field.endswith(("_sec", "_seconds", "_s", "_bytes"))):
         return "lower"
     return "higher"
 

@@ -213,34 +213,50 @@ def _serve_phase(n: int) -> dict:
     ``serve_degraded``/``serve_retries``, a ``preempt`` plan raises
     Preempted through main()'s exit-75 contract.
     """
+    import tempfile
+
     from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
     from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
     from mpi_and_open_mp_tpu.serve.queue import DONE
 
     policy = ServePolicy(max_batch=8, max_depth=max(64, 2 * n),
                          max_wait_s=0.005)
-    daemon = ServingDaemon(policy)
-    rng = np.random.default_rng(48)
-    shapes = ((48, 48), (64, 64))
-    steps = (4, 8)
-    t0 = time.perf_counter()
-    for i in range(n):
-        ny, nx = shapes[i % len(shapes)]
-        daemon.submit((rng.random((ny, nx)) < 0.3).astype(np.uint8),
-                      steps[i % len(steps)])
-    daemon.serve()  # Preempted propagates: the exit-75 contract holds
-    wall = time.perf_counter() - t0
-    s = daemon.summary()
 
-    bad = 0
-    for t in daemon.queue.tickets():
-        if t.state != DONE:
-            continue
-        ref = np.asarray(t.board).copy()
-        for _ in range(t.steps):
-            ref = life_step_numpy(ref)
-        if not np.array_equal(t.result, ref):
-            bad += 1
+    def burst(wal_path=None, wal_fsync="every-record"):
+        """One seeded burst through a fresh daemon; identical request
+        stream either way so the WAL-on/WAL-off delta isolates the
+        journal tax. Returns (summary, wall, oracle-mismatch count)."""
+        daemon = ServingDaemon(policy, wal_path=wal_path,
+                               wal_fsync=wal_fsync)
+        rng = np.random.default_rng(48)
+        shapes = ((48, 48), (64, 64))
+        steps = (4, 8)
+        t0 = time.perf_counter()
+        for i in range(n):
+            ny, nx = shapes[i % len(shapes)]
+            daemon.submit((rng.random((ny, nx)) < 0.3).astype(np.uint8),
+                          steps[i % len(steps)])
+        daemon.serve()  # Preempted propagates: the exit-75 contract
+        wall = time.perf_counter() - t0
+        s = daemon.summary()
+        bad = 0
+        for t in daemon.queue.tickets():
+            if t.state != DONE:
+                continue
+            ref = np.asarray(t.board).copy()
+            for _ in range(t.steps):
+                ref = life_step_numpy(ref)
+            if not np.array_equal(t.result, ref):
+                bad += 1
+        if wal_path is not None:
+            daemon._wal.close()
+        return s, wall, bad
+
+    # The serve_* baseline fields stay WAL-OFF: the regression sentinel
+    # trends them against pre-WAL history, which must not silently
+    # absorb the durability tax. The tax gets its own serve_wal_*
+    # fields from a second identical burst, journaled every-record.
+    s, wall, bad = burst()
     fields = {
         "serve_daemon_requests": s["requests"],
         "serve_admitted": s["requests"] - s["shed_reasons"].get(
@@ -261,6 +277,29 @@ def _serve_phase(n: int) -> dict:
     if bad:
         fields["serve_daemon_error"] = (
             f"parity check failed on {bad} resolved boards")
+
+    with tempfile.TemporaryDirectory(prefix="momp-bench-wal-") as td:
+        ws, wwall, wbad = burst(wal_path=os.path.join(td, "serve.wal"))
+    w = ws["wal"]
+    fields.update({
+        "serve_wal_fsync": w["fsync"],
+        "serve_wal_records": w["records"],
+        "serve_wal_bytes": w["bytes"],
+        "serve_wal_syncs": w["syncs"],
+        "serve_wal_fsync_s": w["sync_seconds"],
+        "serve_wal_p50_latency_s": ws["p50_latency_s"],
+        "serve_wal_p99_latency_s": ws["p99_latency_s"],
+        # The durability tax, directly comparable: same seed, same
+        # request stream, only the journal differs.
+        "serve_wal_p50_delta_s": round(
+            ws["p50_latency_s"] - s["p50_latency_s"], 6),
+        "serve_wal_p99_delta_s": round(
+            ws["p99_latency_s"] - s["p99_latency_s"], 6),
+        "serve_wal_parity": wbad == 0,
+    })
+    if wbad:
+        fields["serve_wal_error"] = (
+            f"parity check failed on {wbad} resolved boards (WAL run)")
     return fields
 
 
@@ -292,8 +331,11 @@ def main(argv=None) -> int:
                     "supervised daemon (serve.daemon — admission control, "
                     "deadline flushes, recovery ladder), reporting "
                     "serve_requests_per_sec and p50/p99 latency plus "
-                    "shed/degrade counts on the JSON line (runs on every "
-                    "backend; honors MOMP_CHAOS)")
+                    "shed/degrade counts on the JSON line, then the same "
+                    "burst again under the every-record write-ahead "
+                    "journal to price the durability tax (serve_wal_* "
+                    "fields incl. p50/p99 delta; runs on every backend; "
+                    "honors MOMP_CHAOS)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="write obs span/event JSONL here (sets MOMP_TRACE; "
                     "summarise with analysis/trace_report.py). The timed "

@@ -2,16 +2,19 @@
 # Fault-tolerant serving drain — the queueable (tpu_queue_loop.sh) form
 # of the daemon cycle, replacing the reference's PBS qsub-requeue
 # workflow (docs/MIGRATION.md): the first pass admits a mixed-shape
-# request burst and drains it through serve.daemon; a preemption
-# (scheduler SIGTERM, or MOMP_CHAOS preempt=K) finishes the in-flight
-# batch, checkpoints the pending queue (crash-atomic CRC state file),
-# and exits 75 — the queue loop keeps this script queued, and the NEXT
-# pass finds the checkpoint and resumes it, so no admitted ticket is
-# ever dropped across passes. Idempotent by design: rerun until exit 0.
+# request burst and drains it through serve.daemon under a write-ahead
+# ticket journal; ANY death — polite preemption (scheduler SIGTERM, or
+# MOMP_CHAOS preempt=K, exit 75 after checkpointing the queue) or an
+# impolite kill -9/OOM that runs no handler at all — leaves either the
+# drain checkpoint or the journal behind, and the NEXT pass resumes
+# whichever survives (WAL first: it is durable at every instruction,
+# not just at the drain). No admitted ticket is ever dropped across
+# passes. Idempotent by design: rerun until exit 0.
 #
 # Usage:
 #   launchers/job_serve.sh [--requests=N] [--max-batch=B] [--shapes=S]
-#                          [--checkpoint=PATH] [--seed=K]
+#                          [--checkpoint=PATH] [--wal=PATH]
+#                          [--wal-fsync=POLICY] [--seed=K]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,6 +22,8 @@ REQUESTS=64
 MAXBATCH=8
 SHAPES=48x48,64x64
 CKPT=/tmp/momp_serve_queue.state
+WAL=/tmp/momp_serve.wal
+WALFSYNC=every-record
 SEED=0
 for arg in "$@"; do
   case "$arg" in
@@ -26,21 +31,26 @@ for arg in "$@"; do
     --max-batch=*)  MAXBATCH="${arg#*=}" ;;
     --shapes=*)     SHAPES="${arg#*=}" ;;
     --checkpoint=*) CKPT="${arg#*=}" ;;
+    --wal=*)        WAL="${arg#*=}" ;;
+    --wal-fsync=*)  WALFSYNC="${arg#*=}" ;;
     --seed=*)       SEED="${arg#*=}" ;;
     *) echo "unknown arg: $arg" >&2; exit 2 ;;
   esac
 done
 
-if [ -f "$CKPT" ]; then
-  echo "serve checkpoint $CKPT exists; resuming drained tickets" >&2
+if [ -s "$WAL" ] || [ -f "$CKPT" ]; then
+  echo "serve state survives ($WAL / $CKPT); resuming drained tickets" >&2
   python -m mpi_and_open_mp_tpu.serve.daemon \
-    --requests 0 --resume --checkpoint "$CKPT" --verify
+    --requests 0 --resume --wal "$WAL" --wal-fsync "$WALFSYNC" \
+    --checkpoint "$CKPT" --verify
 else
   python -m mpi_and_open_mp_tpu.serve.daemon \
     --requests "$REQUESTS" --shapes "$SHAPES" --max-batch "$MAXBATCH" \
-    --seed "$SEED" --checkpoint "$CKPT" --verify
+    --seed "$SEED" --wal "$WAL" --wal-fsync "$WALFSYNC" \
+    --checkpoint "$CKPT" --verify
 fi
 # Only reached on a clean drain (set -e; a preempted pass exits 75
-# above): drop the consumed checkpoint so the next invocation starts a
-# fresh burst instead of re-serving already-resolved tickets.
-rm -f "$CKPT"
+# above, a killed pass never gets here): drop the consumed state —
+# journal, its compaction snapshots, and checkpoint — so the next
+# invocation starts a fresh burst instead of re-serving resolved work.
+rm -f "$CKPT" "$WAL" "$WAL".snap.* "$WAL".corrupt

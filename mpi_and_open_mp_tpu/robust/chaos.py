@@ -38,6 +38,17 @@ Tokens:
     primary engine (:func:`take_serve_fault` consumes the budget) — the
     mid-queue fault that drives the daemon's retry/degrade ladder in the
     chaos soak.
+``crash=<site>:<k>``
+    Hard-kill the process (``os._exit(137)`` — indistinguishable from a
+    SIGKILL to everything outside it: no atexit, no finally, no signal
+    handler) on the ``<k>``-th arrival at the named instrumented site.
+    Sites: ``post-admit`` (ticket admitted to the in-memory queue,
+    journal record NOT yet written), ``mid-frame`` (half of a WAL frame
+    written to the OS, then death — the torn-tail rehearsal),
+    ``post-dispatch`` (batch computed, RESOLVE record NOT yet
+    journaled). The write-ahead journal's crash-matrix test drives all
+    three to prove the per-fsync-policy loss bounds in
+    ``serve/wal.py``.
 ``seed=<int>``
     Seed for corrupted-value generation (default 0).
 ``noguard``
@@ -62,6 +73,13 @@ import os
 _HOP_KINDS = ("nan", "inf")
 _HALO_KINDS = ("corrupt", "drop")
 
+#: Instrumented hard-kill sites for the ``crash=<site>:<k>`` token.
+CRASH_SITES = ("post-admit", "mid-frame", "post-dispatch")
+
+#: The exit status a hard kill reports — 128+SIGKILL, so a requeue loop
+#: or CI harness cannot tell an injected crash from a real ``kill -9``.
+CRASH_EXIT = 137
+
 
 @dataclasses.dataclass
 class FaultPlan:
@@ -77,6 +95,9 @@ class FaultPlan:
     preempt_fired: bool = False  # in-process refire latch
     serve_fail: int = 0  # total serve-dispatch faults to inject
     serve_failed: int = 0  # runtime count consumed so far
+    crash_site: str | None = None  # instrumented site to hard-kill at
+    crash_at: int = 0  # 1-based arrival count that fires the kill
+    crash_hits: int = 0  # runtime arrivals counted so far
 
     @classmethod
     def parse(cls, raw: str) -> "FaultPlan":
@@ -103,6 +124,14 @@ class FaultPlan:
                     plan.serve_fail = int(val)
                     if plan.serve_fail < 0:
                         raise ValueError("negative serve_fail")
+                elif key == "crash":
+                    site, _, k = val.partition(":")
+                    if site not in CRASH_SITES:
+                        raise ValueError(f"want one of {CRASH_SITES}")
+                    plan.crash_site = site
+                    plan.crash_at = int(k) if k else 1
+                    if plan.crash_at < 1:
+                        raise ValueError("crash count must be >= 1")
                 elif key == "seed":
                     plan.seed = int(val)
                 elif key == "noguard" and not val:
@@ -243,6 +272,28 @@ def take_serve_fault() -> bool:
         return False
     plan.serve_failed += 1
     return True
+
+
+def crash_armed(site: str) -> bool:
+    """Count one arrival at instrumented ``site``; ``True`` exactly when
+    this arrival is the planned ``<k>``-th — the caller must then tear
+    whatever the site tears (a partial frame write, nothing) and call
+    :func:`crash_now`. Counting is per-site-name against the single
+    planned site, stateful like the preemption latch, and inert (no
+    counting) when no plan targets this site or injection is
+    :func:`suppressed`."""
+    plan = active_plan()
+    if plan is None or plan.crash_site != site:
+        return False
+    plan.crash_hits += 1
+    return plan.crash_hits == plan.crash_at
+
+
+def crash_now() -> None:
+    """Die as hard as ``kill -9``: ``os._exit`` runs no atexit hooks, no
+    ``finally`` blocks, no signal handlers, flushes nothing — the point
+    is that ONLY what was already durably journaled survives."""
+    os._exit(CRASH_EXIT)
 
 
 def dispatch_delay() -> float:

@@ -42,4 +42,10 @@ from mpi_and_open_mp_tpu.serve.queue import (  # noqa: F401
     ServeQueue,
     Ticket,
 )
+from mpi_and_open_mp_tpu.serve.wal import (  # noqa: F401
+    FSYNC_POLICIES,
+    TicketWAL,
+    WALReplay,
+    replay,
+)
 from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon  # noqa: F401

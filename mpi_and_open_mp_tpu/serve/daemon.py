@@ -29,6 +29,17 @@ take the process down:
   never silently dropped. ``MOMP_CHAOS preempt=<k>`` rehearses the same
   path after ``k`` dispatched batches, and ``serve_fail=<k>`` drives the
   ladder mid-queue.
+* **Hard-kill durability** — the drain checkpoint only exists if the
+  process got to write it; a ``kill -9``/OOM/node loss never runs that
+  code. With ``wal_path`` set, every ticket transition is journaled
+  through the write-ahead log (``serve.wal``) *before* the daemon acts
+  on it — admit, dispatch-begin, resolve, shed — under the
+  policy-selectable fsync ladder, so :meth:`ServingDaemon.resume_any`
+  can reconstruct the exact pending set (plus any in-flight batch, re-
+  dispatched idempotently — dispatch is pure) from a process that died
+  at an *arbitrary* instruction. Resume ladder: WAL snapshot+tail →
+  drain checkpoint → fresh. ``MOMP_CHAOS crash=<site>:<k>`` hard-kills
+  at the instrumented sites so the loss bound is proved, not assumed.
 
 Every admission, shed, retry, degrade, and drain decision emits ``obs``
 spans/events and metrics (``serve.*``), so a bench line or a CI soak can
@@ -40,6 +51,7 @@ from __future__ import annotations
 import argparse
 import collections
 import json
+import os
 import sys
 import time
 
@@ -49,9 +61,11 @@ from mpi_and_open_mp_tpu.robust import chaos, guards, watchdog
 from mpi_and_open_mp_tpu.robust.preempt import (
     EXIT_PREEMPTED, Preempted, SimulatedPreemption, flush_on_signal)
 from mpi_and_open_mp_tpu.serve import policy as policy_mod
+from mpi_and_open_mp_tpu.serve import wal as wal_mod
 from mpi_and_open_mp_tpu.serve.batcher import bucket_batch_size
 from mpi_and_open_mp_tpu.serve.policy import ServePolicy, percentile
-from mpi_and_open_mp_tpu.serve.queue import DONE, SHED, ServeQueue, Ticket
+from mpi_and_open_mp_tpu.serve.queue import (
+    DONE, PENDING, SHED, ServeQueue, Ticket)
 from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
 
 
@@ -66,6 +80,9 @@ class ServingDaemon:
 
     def __init__(self, policy: ServePolicy | None = None, *,
                  checkpoint_path: str | None = None,
+                 wal_path: str | None = None,
+                 wal_fsync: str = "every-record",
+                 wal_compact_bytes: int = 1 << 20,
                  clock=time.monotonic, sleep=time.sleep):
         self.policy = policy or ServePolicy()
         self.queue = ServeQueue(self.policy)
@@ -75,13 +92,34 @@ class ServingDaemon:
         self._batches = 0
         self._retries = 0
         self._degraded = 0
+        # The journal's "one chunk" loss bound under every-chunk is
+        # literal: the buffer never holds more records than one dispatch
+        # batch admits.
+        self._wal = (wal_mod.TicketWAL(
+            wal_path, fsync=wal_fsync,
+            chunk_records=self.policy.max_batch,
+            compact_bytes=wal_compact_bytes)
+            if wal_path else None)
 
     # -- intake ------------------------------------------------------------
 
     def submit(self, board: np.ndarray, steps: int) -> Ticket:
         """Admit (or reject-with-reason) one request; see
-        :meth:`ServeQueue.submit`."""
-        return self.queue.submit(board, steps, self._clock())
+        :meth:`ServeQueue.submit`. An ADMITTED ticket is journaled before
+        this returns — under ``every-record`` fsync the caller's ack
+        implies durability (the crash-matrix's zero-acked-loss bound).
+        Door-shed tickets are terminal before they exist anywhere worth
+        replaying, so they never touch the journal."""
+        t = self.queue.submit(board, steps, self._clock())
+        if t.state == PENDING and self._wal is not None:
+            # Instrumented crash site: admitted in memory, journal record
+            # not yet written. A death here loses a ticket whose submit()
+            # never returned — the caller was never acked, so the
+            # zero-ACKED-loss bound is intact.
+            if chaos.crash_armed("post-admit"):
+                chaos.crash_now()
+            self._wal.admit(t.id, t.board, t.steps)
+        return t
 
     @classmethod
     def resume(cls, checkpoint_path: str,
@@ -96,7 +134,78 @@ class ServingDaemon:
         daemon = cls(policy, checkpoint_path=checkpoint_path, **kw)
         restored = daemon.queue.restore(state, daemon._clock())
         trace.event("serve.resume", tickets=len(restored))
+        if daemon._wal is not None:
+            daemon._compact_wal()
         return daemon
+
+    @classmethod
+    def resume_any(cls, *, wal_path: str | None = None,
+                   checkpoint_path: str | None = None,
+                   policy: ServePolicy | None = None,
+                   wal_fsync: str = "every-record",
+                   **kw) -> tuple["ServingDaemon", str, dict]:
+        """The resume ladder: WAL snapshot+tail → drain checkpoint →
+        fresh. Returns ``(daemon, source, detail)`` where ``source`` is
+        ``"wal"`` / ``"checkpoint"`` / ``"fresh"`` and ``detail`` carries
+        replay accounting (and any swallowed ``wal_error``).
+
+        The WAL rung survives deaths the checkpoint rung cannot: the
+        drain checkpoint exists only if a polite signal handler got to
+        run, while the journal was durable BEFORE the work happened. A
+        WAL whose tail is torn replays to its last complete frame (loss
+        bounded by the fsync policy); a WAL that is unreadable outright
+        falls through to the checkpoint rung rather than refusing to
+        serve. Tickets that were in-flight (DISPATCH without RESOLVE)
+        come back pending — dispatch is pure, so redoing them is
+        idempotent. After a WAL resume the journal is immediately
+        compacted: the restored tickets carry NEW ids in this process,
+        and rotation re-anchors the journal on them (also discarding any
+        torn tail so fresh frames never sit behind garbage)."""
+        from mpi_and_open_mp_tpu.obs import trace
+
+        detail: dict = {}
+        if wal_path and os.path.exists(wal_path):
+            try:
+                rep = wal_mod.replay(wal_path)
+            except ValueError as e:
+                detail["wal_error"] = str(e)[:300]
+                trace.event("serve.resume.wal_error", error=str(e)[:200])
+                # Quarantine the unreadable journal (forensics intact):
+                # appending fresh frames behind a bad head would poison
+                # every future replay too.
+                try:
+                    os.replace(wal_path, wal_path + ".corrupt")
+                except OSError:
+                    pass
+            else:
+                daemon = cls(policy, checkpoint_path=checkpoint_path,
+                             wal_path=wal_path, wal_fsync=wal_fsync, **kw)
+                daemon._wal._generation = rep.generation
+                now = daemon._clock()
+                wall_now = time.time()
+                for entry in rep.pending:
+                    queued = float(entry.get("queued_s", 0.0))
+                    wall = float(entry.get("wall", 0.0))
+                    if wall:
+                        # Seconds the ticket sat in the DEAD process (and
+                        # the gap until this restart) — wall clock is the
+                        # only clock that crosses a process boundary.
+                        queued += max(0.0, wall_now - wall)
+                    daemon.queue.restore_ticket(
+                        entry["board"], entry["steps"], now, queued_s=queued)
+                daemon._compact_wal()
+                detail["wal_replay"] = rep.counts()
+                trace.event("serve.resume", source="wal",
+                            tickets=len(rep.pending))
+                return daemon, "wal", detail
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            daemon = cls.resume(checkpoint_path, policy, wal_path=wal_path,
+                                wal_fsync=wal_fsync, **kw)
+            return daemon, "checkpoint", detail
+        daemon = cls(policy, checkpoint_path=checkpoint_path,
+                     wal_path=wal_path, wal_fsync=wal_fsync, **kw)
+        trace.event("serve.resume", source="fresh", tickets=0)
+        return daemon, "fresh", detail
 
     # -- the supervised loop ----------------------------------------------
 
@@ -110,6 +219,8 @@ class ServingDaemon:
             while True:
                 dispatched = self.pump(watch=watch)
                 if not self.queue.pending():
+                    if self._wal is not None:
+                        self._wal.sync()
                     return
                 if dispatched == 0:
                     self._check_interrupts(watch)
@@ -131,6 +242,8 @@ class ServingDaemon:
             self._check_interrupts(watch)
             self._dispatch_chunk(chunk)
             n += 1
+        if self._wal is not None and self._wal.should_compact():
+            self._compact_wal()
         return n
 
     def drain(self) -> None:
@@ -140,6 +253,33 @@ class ServingDaemon:
             self.pump(drain=True)
 
     # -- internals ---------------------------------------------------------
+
+    def _compact_wal(self) -> None:
+        """Rotate the journal around the CURRENT pending set: one
+        crash-atomic snapshot (generation-stamped ``save_state`` file)
+        plus a fresh WAL whose head frame points at it. Queued seconds
+        are folded to now so a replay in a later process keeps the true
+        end-to-end clock running."""
+        now = self._clock()
+        wall = time.time()
+        entries = [
+            {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
+             "wall": wall,
+             "queued_s": t.queued_before_s + (now - t.submitted_at)}
+            for t in self.queue.pending()
+        ]
+        self._wal.compact(entries)
+
+    def _shed_batch(self, tickets: list[Ticket], reason: str,
+                    now: float) -> None:
+        """Shed a group terminally, journal first — the SHED frame is
+        what stops a replay from re-dispatching work the policy already
+        refused (one frame for the group; the per-ticket accounting
+        lives in the queue)."""
+        if self._wal is not None and tickets:
+            self._wal.shed([t.id for t in tickets], reason)
+        for t in tickets:
+            self.queue.shed_ticket(t, reason, now)
 
     def _check_interrupts(self, watch) -> None:
         if watch is not None and watch.fired is not None:
@@ -158,9 +298,11 @@ class ServingDaemon:
         from mpi_and_open_mp_tpu.obs import metrics, trace
 
         path = None
+        if self._wal is not None:
+            self._wal.sync()
         if self.checkpoint_path:
             checkpoint_mod.save_state(
-                self.checkpoint_path, self.queue.snapshot())
+                self.checkpoint_path, self.queue.snapshot(self._clock()))
             path = self.checkpoint_path
         metrics.inc("serve.preempted")
         trace.event("serve.drain", batches=self._batches,
@@ -230,15 +372,22 @@ class ServingDaemon:
         # retries, chaos delays, a starved bucket) sheds explicitly
         # instead of burning a dispatch whose answer nobody is waiting
         # for.
-        live = []
+        live, stale = [], []
         for t in chunk:
             if now - t.submitted_at > p.request_timeout_s:
-                self.queue.shed_ticket(t, policy_mod.SHED_TIMEOUT, now)
+                stale.append(t)
             else:
                 live.append(t)
+        self._shed_batch(stale, policy_mod.SHED_TIMEOUT, now)
         if not live:
             return
 
+        if self._wal is not None:
+            # DISPATCH_BEGIN before any engine runs: a death between here
+            # and the RESOLVE frame replays these tickets as pending (the
+            # in-flight batch) and redispatches them — dispatch is pure,
+            # so the redo is idempotent.
+            self._wal.dispatch_begin([t.id for t in live])
         shape = live[0].board.shape
         steps = live[0].steps
         padded = bucket_batch_size(len(live), p.max_batch)
@@ -276,15 +425,11 @@ class ServingDaemon:
                             notes="; ".join(e.notes)[:200])
                 now = self._clock()
                 if attempt > p.max_retries:
-                    for t in live:
-                        self.queue.shed_ticket(
-                            t, policy_mod.SHED_DISPATCH, now)
+                    self._shed_batch(live, policy_mod.SHED_DISPATCH, now)
                     return
                 wait = next(waits)
                 if now + wait > deadline:
-                    for t in live:
-                        self.queue.shed_ticket(
-                            t, policy_mod.SHED_TIMEOUT, now)
+                    self._shed_batch(live, policy_mod.SHED_TIMEOUT, now)
                     return
                 self._sleep(wait)
 
@@ -297,6 +442,14 @@ class ServingDaemon:
             guards.record_recovery(f"serve:{stamp}")
         now = self._clock()
         host = np.asarray(out)[:len(live)]
+        if self._wal is not None:
+            # Instrumented crash site: batch computed, RESOLVE frame not
+            # yet journaled. A death here replays the batch as in-flight
+            # and the resumed daemon redoes it — results were never
+            # surfaced, so redoing is the correct (idempotent) outcome.
+            if chaos.crash_armed("post-dispatch"):
+                chaos.crash_now()
+            self._wal.resolve([t.id for t in live], engine=stamp)
         for i, t in enumerate(live):
             self.queue.resolve(t, host[i], stamp, now)
         self._batches += 1
@@ -314,7 +467,7 @@ class ServingDaemon:
         done = [t for t in tickets if t.state == DONE]
         shed = [t for t in tickets if t.state == SHED]
         lat = [t.latency_s for t in done]
-        return {
+        out = {
             "requests": len(tickets),
             "resolved": len(done),
             "shed": len(shed),
@@ -328,6 +481,9 @@ class ServingDaemon:
             "p50_latency_s": round(percentile(lat, 50), 6),
             "p99_latency_s": round(percentile(lat, 99), 6),
         }
+        if self._wal is not None:
+            out["wal"] = self._wal.stats()
+        return out
 
 
 # -- CLI -------------------------------------------------------------------
@@ -359,10 +515,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="queue drain checkpoint file (written on "
-                   "SIGTERM/preemption; required for --resume)")
+                   "SIGTERM/preemption)")
+    p.add_argument("--wal", default=None, metavar="PATH",
+                   help="write-ahead ticket journal: every transition is "
+                   "durable BEFORE the daemon acts on it, so --resume "
+                   "recovers from kill -9 at any instruction, not just "
+                   "a polite SIGTERM drain")
+    p.add_argument("--wal-fsync", default="every-record",
+                   choices=list(wal_mod.FSYNC_POLICIES),
+                   help="journal durability ladder: every-record = zero "
+                   "acked loss on any death; every-chunk = at most one "
+                   "batch of records on power cut; off = page-cache "
+                   "only (still zero loss on process death; default "
+                   "%(default)s)")
     p.add_argument("--resume", action="store_true",
-                   help="restore drained tickets from --checkpoint "
-                   "before serving the (possibly empty) new burst")
+                   help="restore drained tickets before serving the "
+                   "(possibly empty) new burst — WAL replay first, then "
+                   "the drain checkpoint, then fresh (requires --wal "
+                   "and/or --checkpoint)")
     p.add_argument("--verify", action="store_true",
                    help="gate every resolved board bit-exact against the "
                    "NumPy oracle before reporting (CI smoke)")
@@ -400,8 +570,8 @@ def main(argv=None) -> int:
     from mpi_and_open_mp_tpu.obs import metrics
 
     args = build_parser().parse_args(argv)
-    if args.resume and not args.checkpoint:
-        build_parser().error("--resume requires --checkpoint")
+    if args.resume and not (args.checkpoint or args.wal):
+        build_parser().error("--resume requires --checkpoint and/or --wal")
     policy = ServePolicy(
         max_batch=args.max_batch, max_depth=args.max_depth,
         max_wait_s=args.max_wait, request_timeout_s=args.timeout,
@@ -409,10 +579,16 @@ def main(argv=None) -> int:
     rec: dict = {"daemon": "serve", "resume": bool(args.resume)}
     try:
         if args.resume:
-            daemon = ServingDaemon.resume(args.checkpoint, policy)
+            daemon, source, detail = ServingDaemon.resume_any(
+                wal_path=args.wal, checkpoint_path=args.checkpoint,
+                policy=policy, wal_fsync=args.wal_fsync)
+            rec["resume_source"] = source
+            rec.update(detail)
             rec["resumed_tickets"] = daemon.queue.depth()
         else:
-            daemon = ServingDaemon(policy, checkpoint_path=args.checkpoint)
+            daemon = ServingDaemon(
+                policy, checkpoint_path=args.checkpoint,
+                wal_path=args.wal, wal_fsync=args.wal_fsync)
         _burst(daemon, args)
         t0 = time.perf_counter()
         daemon.serve()
@@ -430,6 +606,8 @@ def main(argv=None) -> int:
         return 1
     rec.update({"preempted": False, "wall_sec": round(wall, 4),
                 **daemon.summary()})
+    if daemon._wal is not None:
+        daemon._wal.close()
     if rec["resolved"] and wall > 0:
         rec["requests_per_sec"] = round(rec["resolved"] / wall, 2)
     if args.verify:

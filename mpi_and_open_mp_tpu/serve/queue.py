@@ -48,6 +48,12 @@ class Ticket:
     engine: str | None = None  # provenance stamp of the resolving dispatch
     resolved_at: float | None = None
     resumed: bool = False  # restored from a drain checkpoint
+    #: Seconds this request already spent queued in PREVIOUS processes.
+    #: ``submitted_at`` is re-stamped against the resuming clock
+    #: (monotonic timestamps don't cross a process boundary), so without
+    #: this carry a resumed ticket's latency would silently forget its
+    #: pre-crash queue time and post-resume p99 would flatter the tail.
+    queued_before_s: float = 0.0
 
     @property
     def bucket_key(self) -> tuple:
@@ -55,10 +61,12 @@ class Ticket:
 
     @property
     def latency_s(self) -> float | None:
-        """Submission-to-terminal seconds (``None`` while pending)."""
+        """True end-to-end seconds, first submission to terminal state,
+        across every process that held the ticket (``None`` while
+        pending)."""
         if self.resolved_at is None:
             return None
-        return self.resolved_at - self.submitted_at
+        return self.resolved_at - self.submitted_at + self.queued_before_s
 
 
 class ServeQueue:
@@ -103,15 +111,18 @@ class ServeQueue:
         return t
 
     def restore_ticket(self, board: np.ndarray, steps: int,
-                       now: float) -> Ticket:
+                       now: float, queued_s: float = 0.0) -> Ticket:
         """Re-admit one drained ticket from a checkpoint — NO admission
         gate (it was already admitted once; dropping it now would break
         the never-lose-a-ticket contract). The deadline clock restarts at
-        ``now``: monotonic timestamps don't survive a process boundary."""
+        ``now``: monotonic timestamps don't survive a process boundary,
+        so the seconds already spent queued arrive as ``queued_s`` and
+        keep accruing into :attr:`Ticket.latency_s`."""
         from mpi_and_open_mp_tpu.obs import metrics
 
         t = Ticket(self._next_ticket, np.asarray(board), int(steps),
-                   float(now), resumed=True)
+                   float(now), resumed=True,
+                   queued_before_s=float(queued_s))
         self._next_ticket += 1
         self._tickets[t.id] = t
         metrics.inc("serve.requests")
@@ -203,16 +214,22 @@ class ServeQueue:
 
     # -- checkpoint round trip --------------------------------------------
 
-    def snapshot(self) -> dict:
+    def snapshot(self, now: float | None = None) -> dict:
         """The pending set as a picklable tree for
         ``utils.checkpoint.save_state`` — ticket order, payloads, step
-        counts, and the original ids (provenance: an operator can map a
-        resumed ticket back to the pre-preemption submission)."""
+        counts, the original ids (provenance: an operator can map a
+        resumed ticket back to the pre-preemption submission), and each
+        ticket's cumulative queued seconds as of ``now`` (pass the
+        drain clock so a resumed ticket's latency keeps counting from
+        its FIRST submission, not the restore)."""
         return {
             "schema": STATE_SCHEMA,
             "next_ticket": self._next_ticket,
             "pending": [
-                {"id": t.id, "board": np.asarray(t.board), "steps": t.steps}
+                {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
+                 "queued_s": (t.queued_before_s
+                              + (float(now) - t.submitted_at
+                                 if now is not None else 0.0))}
                 for t in self.pending()
             ],
         }
@@ -238,5 +255,7 @@ class ServeQueue:
                 raise ValueError(
                     f"serve-queue checkpoint entry is malformed: {item!r}"
                 ) from e
-            out.append(self.restore_ticket(board, steps, now))
+            out.append(self.restore_ticket(
+                board, steps, now,
+                queued_s=float(item.get("queued_s", 0.0))))
         return out

@@ -32,6 +32,30 @@ import jax
 _CKPTR = None
 
 
+def _fsync_dir(path: str | os.PathLike) -> None:
+    """fsync the directory CONTAINING ``path`` — the missing half of
+    rename-based crash atomicity. ``os.replace`` makes the swap atomic
+    against readers, but the rename itself lives in the directory inode:
+    until that inode reaches disk, a power cut can roll the directory
+    back to the pre-rename entry (or, worse, to neither name on some
+    filesystems). Every tmp+fsync+replace sequence in this module ends
+    here so the *rename* is as durable as the bytes. Best-effort on
+    platforms that cannot open a directory read-only (Windows): the
+    atomicity-against-crashed-writers guarantee stands everywhere, the
+    power-cut guarantee only where the OS allows it."""
+    d = os.path.dirname(os.path.abspath(os.fspath(path))) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _checkpointer():
     """Module-cached PyTreeCheckpointer: constructing one spins up thread
     pools and a tensorstore context, too costly to pay per save inside the
@@ -83,6 +107,7 @@ def save(path: str | os.PathLike, board: jax.Array, step: int) -> None:
         if os.path.exists(path):
             shutil.rmtree(path)
         os.replace(tmp, path)
+        _fsync_dir(path)
     metrics.inc("checkpoint.saves")
     metrics.inc("checkpoint.save.bytes", nbytes)
     metrics.observe("checkpoint.save_seconds", t.elapsed)
@@ -171,6 +196,7 @@ def save_state(path: str | os.PathLike, state) -> None:
             fd.flush()
             os.fsync(fd.fileno())
         os.replace(tmp, path)
+        _fsync_dir(path)
     metrics.inc("checkpoint.state_saves")
     metrics.inc("checkpoint.state_save.bytes", len(blob))
 

@@ -1,0 +1,56 @@
+"""Subprocess driver for the WAL crash-matrix test.
+
+Runs a real serving daemon with a write-ahead journal and a
+``MOMP_CHAOS crash=<site>:<k>`` plan armed by the parent test, acking
+every ticket whose ``submit()`` RETURNED to a side file (write + flush +
+fsync, so the ack record is durable before the parent can read it). The
+chaos site hard-kills the process with ``os._exit(137)`` — no atexit, no
+finally — and the parent then replays the journal and asserts the
+per-fsync-policy loss bound over exactly the acked set.
+
+Usage: ``python _wal_crash_driver.py WAL_PATH FSYNC_POLICY ACK_PATH N``
+
+Exits 0 after a clean drain (printing a one-line JSON summary); a
+planned crash never reaches that code.
+"""
+
+import json
+import os
+import sys
+
+# The sitecustomize in this environment points jax at the TPU plugin;
+# this driver is CPU-only host-side work and must never touch the chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
+
+    wal_path, fsync, ack_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    n = int(sys.argv[4])
+    policy = ServePolicy(max_batch=4, max_wait_s=0.0)
+    daemon = ServingDaemon(policy, wal_path=wal_path, wal_fsync=fsync)
+    rng = np.random.default_rng(7)
+    with open(ack_path, "ab") as ack:
+        for i in range(n):
+            board = (rng.random((12, 12)) < 0.3).astype(np.uint8)
+            t = daemon.submit(board, 2)
+            ack.write(f"{t.id}\n".encode())
+            ack.flush()
+            os.fsync(ack.fileno())
+    daemon.serve()
+    s = daemon.summary()
+    daemon._wal.close()
+    print(json.dumps({"resolved": s["resolved"], "shed": s["shed"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -267,6 +267,33 @@ def test_state_checkpoint_truncation_fails_clean(tmp_path):
             checkpoint.restore_state(trunc)
 
 
+def test_state_checkpoint_fsyncs_parent_directory(tmp_path, monkeypatch):
+    """The satellite regression: rename-based atomicity is only durable
+    once the DIRECTORY inode holding the new name is synced —
+    ``save_state`` must fsync the parent dir after ``os.replace``, not
+    just the file bytes before it."""
+    import os
+    import stat
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    synced_dirs = []
+    real_fsync = os.fsync
+
+    def spy_fsync(fd):
+        if stat.S_ISDIR(os.fstat(fd).st_mode):
+            synced_dirs.append(os.path.realpath(f"/proc/self/fd/{fd}")
+                               if os.path.exists(f"/proc/self/fd/{fd}")
+                               else "<dir>")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    path = tmp_path / "sub" / "queue.state"
+    checkpoint.save_state(path, {"n": 1})
+    assert synced_dirs, "save_state never fsynced a directory fd"
+    assert any(d.endswith("sub") or d == "<dir>" for d in synced_dirs)
+
+
 def test_state_checkpoint_garbage_crc_and_missing(tmp_path):
     import pytest
 

@@ -216,6 +216,10 @@ def test_direction_for_name_keying():
     assert d("serve_p99_latency_s") == "lower"
     assert d("serve_shed") == "lower"
     assert d("serve_degraded") == "lower"
+    # The WAL durability-tax fields: swelling journal volume or sync
+    # stall is the regression.
+    assert d("serve_wal_bytes") == "lower"
+    assert d("serve_wal_fsync_s") == "lower"
 
 
 def test_sentinel_flags_p99_inflation(tmp_path, capsys):

@@ -60,10 +60,27 @@ def test_fault_plan_parses_full_spec():
 
 @pytest.mark.parametrize("bad", [
     "nan_hop=x", "halo=melt", "delay=-1", "preempt=ten", "bogus=1", "noguard=1",
+    "crash=elsewhere:1", "crash=mid-frame:0", "crash=post-admit:x",
 ])
 def test_fault_plan_rejects_bad_tokens(bad):
     with pytest.raises(ValueError, match="MOMP_CHAOS"):
         chaos.FaultPlan.parse(f"seed=1;{bad}")
+
+
+def test_crash_token_parses_and_arms(monkeypatch):
+    plan = chaos.FaultPlan.parse("crash=mid-frame:3")
+    assert plan.crash_site == "mid-frame" and plan.crash_at == 3
+    assert chaos.FaultPlan.parse("crash=post-admit").crash_at == 1
+
+    monkeypatch.setenv("MOMP_CHAOS", "crash=post-dispatch:2")
+    chaos.reset()
+    # Wrong site never counts; the right site fires exactly on arrival k.
+    assert not chaos.crash_armed("post-admit")
+    assert not chaos.crash_armed("post-dispatch")  # arrival 1 of 2
+    with chaos.suppressed():
+        assert not chaos.crash_armed("post-dispatch")  # inert, no count
+    assert chaos.crash_armed("post-dispatch")  # arrival 2: fire
+    assert not chaos.crash_armed("post-dispatch")  # never refires
 
 
 def test_preempt_pending_latch_and_resume_semantics():

@@ -152,6 +152,29 @@ def test_queue_snapshot_restore_roundtrip_and_rejects_foreign(make_board):
                     "pending": [{"id": 1}]}, now=0.0)
 
 
+def test_queue_snapshot_carries_queued_seconds(make_board):
+    """The satellite regression: a ticket that sat queued 3 s before the
+    drain must NOT restart its latency clock on resume — the snapshot
+    carries cumulative queued seconds and ``latency_s`` keeps counting
+    from the FIRST submission."""
+    q = ServeQueue(ServePolicy())
+    q.submit(make_board(8, 8), 1, now=10.0)
+    snap = q.snapshot(now=13.0)  # drained after 3 s queued
+    assert snap["pending"][0]["queued_s"] == pytest.approx(3.0)
+
+    q2 = ServeQueue(ServePolicy())
+    (t,) = q2.restore(snap, now=0.0)  # fresh process, fresh clock
+    assert t.queued_before_s == pytest.approx(3.0)
+    q2.resolve(t, t.board, "oracle", now=2.0)
+    assert t.latency_s == pytest.approx(5.0)  # 3 s before + 2 s after
+
+    # A second drain/restore keeps accumulating, never resets.
+    q2._tickets.clear()
+    (t2,) = q2.restore(snap, now=5.0)
+    snap2 = q2.snapshot(now=9.0)
+    assert snap2["pending"][0]["queued_s"] == pytest.approx(7.0)
+
+
 # ------------------------------------------------------------------ daemon
 
 
@@ -405,3 +428,10 @@ def test_bench_serve_phase_fields(monkeypatch, capsys):
     assert rec["serve_p99_latency_s"] >= rec["serve_p50_latency_s"] >= 0
     assert rec["serve_requests_per_sec"] > 0
     assert rec["serve_shed_reasons"] == {}
+    # The WAL-on second burst prices the durability tax on the same line
+    # (baseline serve_* fields stay WAL-off for the sentinel's history).
+    assert rec["serve_wal_fsync"] == "every-record"
+    assert rec["serve_wal_records"] >= 6 and rec["serve_wal_bytes"] > 0
+    assert rec["serve_wal_syncs"] > 0 and rec["serve_wal_fsync_s"] >= 0
+    assert rec["serve_wal_parity"] is True
+    assert rec["serve_wal_p99_latency_s"] >= rec["serve_wal_p50_latency_s"]

@@ -1,0 +1,544 @@
+"""Write-ahead ticket journal: torn tails, fsync ladder, crash matrix.
+
+The durability contracts under test: ``replay`` reconstructs the exact
+pending set from any clean frame prefix and treats a torn/corrupt tail
+as truncation, never a traceback (seeded fuzzers over random cuts and
+byte flips); the ``every-chunk`` policy buffers in USER space so its
+loss bound is honest under SIGKILL; compaction rotates crash-atomically
+(either the old self-contained journal or the new snapshot+head is
+authoritative, never a mix); the daemon's resume ladder prefers WAL
+over drain checkpoint over fresh; and the crash matrix — a real
+subprocess hard-killed by ``MOMP_CHAOS crash=<site>:<k>`` at every
+instrumented site — proves the per-policy loss bound over exactly the
+set of ACKED tickets: zero under ``every-record`` (and, on process
+death, under ``off``), at most one chunk under ``every-chunk``.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from conftest import oracle_n
+from mpi_and_open_mp_tpu.robust import chaos
+from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
+from mpi_and_open_mp_tpu.serve import wal
+from mpi_and_open_mp_tpu.serve.queue import DONE
+from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_wal_crash_driver.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += s
+
+
+def _board(rng, n=12):
+    return (rng.random((n, n)) < 0.3).astype(np.uint8)
+
+
+# ------------------------------------------------------------------ basics
+
+
+def test_wal_roundtrip_replay(tmp_path, rng):
+    w = wal.TicketWAL(tmp_path / "t.wal")
+    boards = [_board(rng) for _ in range(3)]
+    for i, b in enumerate(boards):
+        w.admit(i, b, 2, queued_s=0.5 * i)
+    w.dispatch_begin([0, 1])
+    w.resolve([0, 1], engine="batch:xla")
+    w.shed([7], "queue-depth")  # an id never admitted: terminal-only
+    w.close()
+
+    rep = wal.replay(tmp_path / "t.wal")
+    assert not rep.truncated and rep.frames == 6
+    assert {e["id"] for e in rep.pending} == {2}
+    np.testing.assert_array_equal(rep.pending[0]["board"], boards[2])
+    assert rep.pending[0]["steps"] == 2
+    assert rep.pending[0]["queued_s"] == pytest.approx(1.0)
+    assert rep.pending[0]["wall"] == pytest.approx(time.time(), abs=60)
+    assert rep.resolved_ids == {0, 1} and rep.shed_ids == {7}
+    assert rep.in_flight_ids == set()
+    assert rep.counts()["pending"] == 1
+
+
+def test_wal_open_dispatch_replays_as_in_flight(tmp_path, rng):
+    """DISPATCH without a covering RESOLVE = the process died mid-batch:
+    the tickets stay pending (redispatch is idempotent) and are reported
+    in_flight for the accounting line."""
+    w = wal.TicketWAL(tmp_path / "t.wal")
+    for i in range(4):
+        w.admit(i, _board(rng), 2)
+    w.dispatch_begin([0, 1, 2, 3])
+    w.close()
+    rep = wal.replay(tmp_path / "t.wal")
+    assert {e["id"] for e in rep.pending} == {0, 1, 2, 3}
+    assert rep.in_flight_ids == {0, 1, 2, 3}
+
+
+def test_wal_rejects_non_journal_and_inconsistency(tmp_path, rng):
+    with pytest.raises(ValueError, match="no readable"):
+        wal.replay(tmp_path / "missing.wal")
+    bad = tmp_path / "bad.wal"
+    bad.write_bytes(b"definitely not a journal\n" * 4)
+    with pytest.raises(ValueError, match="magic"):
+        wal.replay(bad)
+
+    w = wal.TicketWAL(tmp_path / "dup.wal")
+    w.admit(5, _board(rng), 1)
+    w.admit(5, _board(rng), 1)  # the writer should never do this
+    w.close()
+    with pytest.raises(ValueError, match="re-admits"):
+        wal.replay(tmp_path / "dup.wal")
+
+    w = wal.TicketWAL(tmp_path / "late.wal")
+    w.admit(0, _board(rng), 1)
+    w._append("COMPACT", {"generation": 1, "count": 0})  # not at head
+    w.close()
+    with pytest.raises(ValueError, match="COMPACT"):
+        wal.replay(tmp_path / "late.wal")
+
+    w = wal.TicketWAL(tmp_path / "unk.wal")
+    w._append("FROB", {"x": 1})
+    w.close()
+    with pytest.raises(ValueError, match="unknown record type"):
+        wal.replay(tmp_path / "unk.wal")
+
+    with pytest.raises(ValueError, match="fsync policy"):
+        wal.TicketWAL(tmp_path / "x.wal", fsync="sometimes")
+
+
+# --------------------------------------------------------------- torn tails
+
+
+def _parse_frames(path):
+    """Independent mini-parser: byte spans + decoded records, so the
+    fuzzers can compute the EXPECTED recovery for any prefix."""
+    blob = open(path, "rb").read()
+    assert blob.startswith(wal.WAL_MAGIC)
+    off = len(wal.WAL_MAGIC)
+    frames = []
+    while off < len(blob):
+        length, _crc = wal._FRAME.unpack_from(blob, off)
+        end = off + wal._FRAME.size + length
+        rtype, rec = pickle.loads(blob[off + wal._FRAME.size:end])
+        frames.append({"start": off, "end": end, "rtype": rtype,
+                       "rec": rec})
+        off = end
+    return blob, frames
+
+
+def _expected_state(frames):
+    pending, in_flight, resolved, shed = {}, set(), set(), set()
+    for f in frames:
+        r = f["rec"]
+        if f["rtype"] == "ADMIT":
+            pending[r["id"]] = r
+        elif f["rtype"] == "DISPATCH":
+            in_flight.update(i for i in r["ids"] if i in pending)
+        elif f["rtype"] == "RESOLVE":
+            for i in r["ids"]:
+                pending.pop(i, None)
+                in_flight.discard(i)
+                resolved.add(i)
+        elif f["rtype"] == "SHED":
+            for i in r["ids"]:
+                pending.pop(i, None)
+                in_flight.discard(i)
+                shed.add(i)
+    return pending, in_flight, resolved, shed
+
+
+def _build_journal(path, rng):
+    w = wal.TicketWAL(path)
+    nxt = 0
+    for _ in range(5):
+        batch = []
+        for _ in range(int(rng.integers(2, 5))):
+            w.admit(nxt, _board(rng, 8), int(rng.integers(1, 4)))
+            batch.append(nxt)
+            nxt += 1
+        w.dispatch_begin(batch)
+        if rng.random() < 0.7:
+            w.resolve(batch, engine="batch:xla")
+        else:
+            w.shed(batch, "dispatch-failed")
+    w.admit(nxt, _board(rng, 8), 2)  # leave one genuinely pending
+    w.close()
+
+
+def test_torn_write_fuzzer_random_cuts(tmp_path):
+    """Seeded fuzz: the journal truncated at ANY byte offset must replay
+    to exactly the state of its complete-frame prefix — never raise,
+    never resurrect a terminal ticket, never drop a journaled one."""
+    rng = np.random.default_rng(20260805)
+    _build_journal(tmp_path / "full.wal", rng)
+    blob, frames = _parse_frames(tmp_path / "full.wal")
+    ends = {f["end"] for f in frames}
+
+    cuts = sorted({int(c) for c in rng.integers(
+        len(wal.WAL_MAGIC), len(blob), size=60)} | {len(blob) - 1})
+    for cut in cuts:
+        p = tmp_path / "cut.wal"
+        p.write_bytes(blob[:cut])
+        rep = wal.replay(p)
+        keep = [f for f in frames if f["end"] <= cut]
+        pending, in_flight, resolved, shed = _expected_state(keep)
+        assert {e["id"] for e in rep.pending} == set(pending), f"cut={cut}"
+        assert rep.in_flight_ids == in_flight, f"cut={cut}"
+        assert rep.resolved_ids == resolved and rep.shed_ids == shed
+        assert rep.truncated == (cut not in ends), f"cut={cut}"
+        if rep.truncated:
+            assert rep.truncated_at == (keep[-1]["end"] if keep
+                                        else len(wal.WAL_MAGIC))
+
+
+def test_torn_write_fuzzer_byte_flips(tmp_path):
+    """Seeded fuzz: ONE flipped byte anywhere past the magic truncates
+    replay at the frame containing it (CRC32 catches every single-byte
+    error) — the clean prefix survives untouched."""
+    rng = np.random.default_rng(48)
+    _build_journal(tmp_path / "full.wal", rng)
+    blob, frames = _parse_frames(tmp_path / "full.wal")
+
+    offs = sorted({int(o) for o in rng.integers(
+        len(wal.WAL_MAGIC), len(blob), size=40)})
+    for off in offs:
+        flipped = bytearray(blob)
+        flipped[off] ^= 0x5A
+        p = tmp_path / "flip.wal"
+        p.write_bytes(bytes(flipped))
+        rep = wal.replay(p)
+        hit = next(f for f in frames if f["start"] <= off < f["end"])
+        keep = [f for f in frames if f["end"] <= hit["start"]]
+        pending, in_flight, resolved, shed = _expected_state(keep)
+        assert {e["id"] for e in rep.pending} == set(pending), f"off={off}"
+        assert rep.resolved_ids == resolved and rep.shed_ids == shed
+        assert rep.truncated and rep.truncated_at == hit["start"]
+
+
+# ------------------------------------------------------------- fsync ladder
+
+
+def test_every_chunk_buffers_in_user_space(tmp_path, rng):
+    """The honesty core of the ``every-chunk`` bound: records buffer in
+    the PROCESS (invisible to a reader — exactly what a SIGKILL loses),
+    flush at chunk-lifecycle records or a full buffer, and ``sync()``
+    forces the rest out."""
+    path = tmp_path / "c.wal"
+    w = wal.TicketWAL(path, fsync="every-chunk", chunk_records=4)
+    for i in range(3):
+        w.admit(i, _board(rng), 1)
+    assert wal.replay(path).counts()["pending"] == 0  # still buffered
+    w.admit(3, _board(rng), 1)  # 4th record fills the buffer
+    assert wal.replay(path).counts()["pending"] == 4
+    w.admit(4, _board(rng), 1)
+    assert wal.replay(path).counts()["pending"] == 4  # buffered again
+    w.dispatch_begin([0, 1, 2, 3])  # chunk boundary flushes everything
+    rep = wal.replay(path)
+    assert rep.counts()["pending"] == 5 and rep.in_flight_ids == {0, 1, 2, 3}
+    w.admit(5, _board(rng), 1)
+    w.sync()
+    assert wal.replay(path).counts()["pending"] == 6
+    w.close()
+
+
+def test_fsync_policy_stats(tmp_path, rng):
+    per_record = wal.TicketWAL(tmp_path / "r.wal", fsync="every-record")
+    off = wal.TicketWAL(tmp_path / "o.wal", fsync="off")
+    for i in range(6):
+        per_record.admit(i, _board(rng), 1)
+        off.admit(i, _board(rng), 1)
+    # +1: opening a fresh journal syncs its magic header (a one-time
+    # cost every policy pays — the file's EXISTENCE should be durable).
+    assert per_record.stats()["syncs"] == 7
+    assert off.stats()["syncs"] == 1  # the header only, never an append
+    assert per_record.stats()["records"] == off.stats()["records"] == 6
+    assert per_record.stats()["bytes"] == off.stats()["bytes"] > 0
+    per_record.close()
+    off.close()
+
+
+# -------------------------------------------------------------- compaction
+
+
+def test_compaction_rotates_and_replays(tmp_path, rng):
+    path = tmp_path / "c.wal"
+    w = wal.TicketWAL(path, compact_bytes=1)  # rotate on any traffic
+    boards = {i: _board(rng) for i in range(6)}
+    for i in range(6):
+        w.admit(i, boards[i], 3, queued_s=float(i))
+    w.resolve([0, 1], engine="batch:xla")
+    assert w.should_compact()
+    size_before = os.path.getsize(path)
+    w.compact([{"id": i, "board": boards[i], "steps": 3,
+                "wall": time.time(), "queued_s": float(i)}
+               for i in (2, 3, 4, 5)])
+    assert os.path.getsize(path) < size_before
+    assert os.path.exists(wal._snap_path(str(path), 1))
+    assert w.stats()["compactions"] == 1 and w.stats()["generation"] == 1
+
+    rep = wal.replay(path)
+    assert rep.generation == 1 and not rep.truncated
+    assert {e["id"] for e in rep.pending} == {2, 3, 4, 5}
+    np.testing.assert_array_equal(rep.pending[0]["board"], boards[2])
+
+    # The tail keeps appending after rotation and replays over the snap.
+    w.resolve([2, 3], engine="batch:xla")
+    rep = wal.replay(path)
+    assert {e["id"] for e in rep.pending} == {4, 5}
+
+    # A second rotation unlinks the superseded snapshot.
+    w.compact([{"id": 4, "board": boards[4], "steps": 3}])
+    assert not os.path.exists(wal._snap_path(str(path), 1))
+    assert os.path.exists(wal._snap_path(str(path), 2))
+    assert wal.replay(path).counts()["pending"] == 1
+    w.close()
+
+
+def test_compaction_crash_windows(tmp_path, rng):
+    """Both halves of the rotation's crash window: an ORPHAN snapshot
+    (died between snapshot write and journal swap) is ignored — the old
+    self-contained journal stays authoritative; a MISSING/mismatched
+    snapshot behind a COMPACT head is a hard ValueError (no safe
+    reconstruction) so the resume ladder falls to the drain
+    checkpoint."""
+    path = tmp_path / "c.wal"
+    w = wal.TicketWAL(path)
+    for i in range(3):
+        w.admit(i, _board(rng), 2)
+    w.close()
+    # Crash between step (1) and (2): the next-generation snapshot got
+    # written but the journal swap never happened.
+    checkpoint_mod.save_state(wal._snap_path(str(path), 1), {
+        "schema": wal.WAL_SNAP_SCHEMA, "generation": 1, "pending": []})
+    rep = wal.replay(path)
+    assert rep.generation == 0 and rep.counts()["pending"] == 3
+
+    w = wal.TicketWAL(path, compact_bytes=1)
+    w.compact([{"id": 0, "board": _board(rng), "steps": 2}])
+    w.close()
+    os.unlink(wal._snap_path(str(path), 1))
+    with pytest.raises(ValueError, match="snapshot"):
+        wal.replay(path)
+
+
+# ---------------------------------------------------------- daemon + ladder
+
+
+def _daemon(policy, clk=None, **kw):
+    clk = clk or FakeClock()
+    return ServingDaemon(policy, clock=clk, sleep=clk.sleep, **kw), clk
+
+
+def test_daemon_wal_resume_zero_loss_in_flight_redispatch(
+        tmp_path, make_board):
+    """A daemon that simply VANISHES mid-queue (no drain code runs, one
+    batch resolved, one journaled DISPATCH left open): resume_any
+    rebuilds every unresolved ticket from the journal — including the
+    in-flight batch, redispatched idempotently — and the books balance
+    with oracle parity."""
+    path = str(tmp_path / "serve.wal")
+    pol = ServePolicy(max_batch=4, max_wait_s=0.0)
+    d, clk = _daemon(pol, wal_path=path)
+    boards = [make_board(16, 16) for _ in range(12)]
+    for b in boards:
+        d.submit(b, 2)
+    chunk = d.queue.due_chunks(clk.t, drain=True)[0]
+    d._dispatch_chunk(chunk)  # resolves tickets 0-3, journals RESOLVE
+    d._wal.dispatch_begin([4, 5, 6, 7])  # died with this batch open
+    # No close(), no drain — the process is gone.
+
+    d2, source, detail = ServingDaemon.resume_any(wal_path=path, policy=pol)
+    assert source == "wal"
+    assert detail["wal_replay"]["pending"] == 8
+    assert detail["wal_replay"]["in_flight"] == 4
+    assert detail["wal_replay"]["resolved"] == 4
+    assert d2.queue.depth() == 8
+    d2.drain()
+    s = d2.summary()
+    assert s["resolved"] == 8 and s["shed"] == 0 and s["pending"] == 0
+    for t, b in zip(d2.queue.tickets(), boards[4:]):
+        np.testing.assert_array_equal(t.board, b)
+        np.testing.assert_array_equal(t.result, oracle_n(b, 2))
+    # The resume rotated the journal: a THIRD process sees only the
+    # post-resume truth, with the new process's ids.
+    rep = wal.replay(path)
+    assert rep.generation >= 1 and rep.counts()["pending"] == 0
+
+
+def test_daemon_journals_sheds(tmp_path, make_board):
+    """A shed is a terminal transition: replay must not resurrect it."""
+    path = str(tmp_path / "s.wal")
+    d, clk = _daemon(
+        ServePolicy(max_wait_s=0.0, request_timeout_s=1.0), wal_path=path)
+    d.submit(make_board(8, 8), 1)
+    clk.t = 5.0  # ages past the budget while queued
+    d.serve()
+    rep = wal.replay(path)
+    assert rep.counts()["pending"] == 0 and rep.shed_ids == {0}
+
+
+def test_daemon_wal_queued_seconds_survive_process_gap(
+        tmp_path, make_board):
+    """Latency honesty across the crash: seconds queued in the dead
+    process AND the dead time until restart both land in the resumed
+    ticket's latency (via the ADMIT record's wall clock)."""
+    path = str(tmp_path / "q.wal")
+    w = wal.TicketWAL(path)
+    w.admit(0, make_board(8, 8), 1, wall=time.time() - 30.0, queued_s=5.0)
+    w.close()
+    d2, source, _ = ServingDaemon.resume_any(
+        wal_path=path, policy=ServePolicy(max_wait_s=0.0))
+    assert source == "wal"
+    (t,) = d2.queue.pending()
+    assert t.queued_before_s == pytest.approx(35.0, abs=5.0)
+    d2.drain()
+    assert t.latency_s >= 30.0
+
+
+def test_resume_any_ladder_order(tmp_path, make_board):
+    """WAL beats checkpoint beats fresh; an unreadable WAL is
+    quarantined and falls through with the error on the record."""
+    pol = ServePolicy(max_wait_s=0.0)
+    d, source, detail = ServingDaemon.resume_any(
+        wal_path=str(tmp_path / "none.wal"),
+        checkpoint_path=str(tmp_path / "none.ck"), policy=pol)
+    assert source == "fresh" and d.queue.depth() == 0
+
+    # The fresh rung CREATED none.wal (a daemon journals from birth):
+    # an existing journal is authoritative on the next resume, even
+    # empty — the checkpoint below it may be stale.
+    assert os.path.exists(tmp_path / "none.wal")
+
+    ck = str(tmp_path / "q.ck")
+    q = ServingDaemon(pol).queue
+    q.submit(make_board(8, 8), 1, 0.0)
+    checkpoint_mod.save_state(ck, q.snapshot(0.0))
+    d, source, _ = ServingDaemon.resume_any(
+        wal_path=str(tmp_path / "sub" / "never.wal"), checkpoint_path=ck,
+        policy=pol)
+    assert source == "checkpoint" and d.queue.depth() == 1
+
+    walp = str(tmp_path / "q.wal")
+    w = wal.TicketWAL(walp)
+    for i in range(2):
+        w.admit(i, make_board(8, 8), 1)
+    w.close()
+    d, source, _ = ServingDaemon.resume_any(
+        wal_path=walp, checkpoint_path=ck, policy=pol)
+    assert source == "wal" and d.queue.depth() == 2
+
+    bad = str(tmp_path / "bad.wal")
+    with open(bad, "wb") as fd:
+        fd.write(b"garbage, not a journal")
+    d, source, detail = ServingDaemon.resume_any(
+        wal_path=bad, checkpoint_path=ck, policy=pol)
+    assert source == "checkpoint" and "magic" in detail["wal_error"]
+    assert os.path.exists(bad + ".corrupt")  # quarantined, not appended-to
+    assert d.queue.depth() == 1
+
+
+def test_daemon_cli_wal_clean_run_and_resume_flags(
+        tmp_path, capsys, make_board):
+    """CLI surface: --wal journals a clean burst (stats on the line),
+    --resume accepts --wal without --checkpoint, and the resumed line
+    carries the replay accounting."""
+    from mpi_and_open_mp_tpu.serve import daemon as daemon_cli
+
+    walp = str(tmp_path / "cli.wal")
+    rc = daemon_cli.main(["--requests", "6", "--max-batch", "4",
+                          "--max-wait", "0", "--wal", walp, "--verify"])
+    line = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and line["verified"] is True
+    assert line["wal"]["fsync"] == "every-record"
+    assert line["wal"]["records"] >= 6 and line["wal"]["syncs"] > 0
+    rep = wal.replay(walp)
+    assert rep.counts()["pending"] == 0 and len(rep.resolved_ids) == 6
+
+    rc = daemon_cli.main(["--requests", "0", "--resume", "--wal", walp,
+                          "--verify"])
+    line = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and line["resume_source"] == "wal"
+    assert line["wal_replay"]["pending"] == 0
+    assert line["resumed_tickets"] == 0
+
+    with pytest.raises(SystemExit) as ei:
+        daemon_cli.main(["--resume"])  # neither --wal nor --checkpoint
+    assert ei.value.code == 2
+
+
+# ------------------------------------------------------------- crash matrix
+
+
+#: (site, k): where the injected ``os._exit(137)`` lands. post-admit and
+#: mid-frame fire inside the submit loop (k-th arrival); post-dispatch
+#: fires after the first batch computed, before its RESOLVE journaled.
+CRASH_CELLS = [("post-admit", 4), ("mid-frame", 4), ("post-dispatch", 1)]
+
+
+@pytest.mark.parametrize("fsync", list(wal.FSYNC_POLICIES))
+@pytest.mark.parametrize("site,k", CRASH_CELLS)
+def test_crash_matrix_loss_bounds(tmp_path, site, k, fsync):
+    """THE acceptance gate: a real subprocess daemon hard-killed at every
+    instrumented site, under every fsync policy. The loss bound is
+    measured over exactly the ACKED set (ids whose submit() returned,
+    durably recorded by the driver): zero for every-record, zero on
+    process death for off, at most one chunk (chunk_records=max_batch=4)
+    for every-chunk. Whatever survived must then resume and drain to
+    oracle parity — recovery, not just bookkeeping."""
+    walp = str(tmp_path / "crash.wal")
+    ackp = str(tmp_path / "acked.ids")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MOMP_CHAOS=f"crash={site}:{k}")
+    proc = subprocess.run(
+        [sys.executable, DRIVER, walp, fsync, ackp, "6"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == chaos.CRASH_EXIT == 137, (
+        f"crash never fired: rc={proc.returncode} "
+        f"out={proc.stdout!r} err={proc.stderr!r}")
+
+    acked = {int(line) for line in open(ackp)} if os.path.exists(ackp) \
+        else set()
+    assert acked, "driver acked nothing — the cell tested nothing"
+    rep = wal.replay(walp)
+    accounted = ({e["id"] for e in rep.pending}
+                 | rep.resolved_ids | rep.shed_ids)
+    lost = acked - accounted
+    if fsync == "every-chunk":
+        assert len(lost) <= 4, (site, fsync, sorted(lost))
+    else:  # every-record: durable before ack; off: page cache survives
+        assert lost == set(), (site, fsync, sorted(lost))
+
+    # Recovery end-to-end: resume the survivors, drain, oracle parity.
+    d, source, detail = ServingDaemon.resume_any(
+        wal_path=walp, policy=ServePolicy(max_batch=4, max_wait_s=0.0))
+    assert source == "wal"
+    assert d.queue.depth() == len(rep.pending)
+    d.drain()
+    s = d.summary()
+    assert s["resolved"] == len(rep.pending) and s["pending"] == 0
+    for t in d.queue.tickets():
+        assert t.state == DONE
+        np.testing.assert_array_equal(
+            t.result, oracle_n(t.board, t.steps))
