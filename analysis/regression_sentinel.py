@@ -70,6 +70,13 @@ WATCH_FIELDS = (
     "serve_p99_latency_s",
     "serve_wal_bytes",
     "serve_wal_fsync_s",
+    # AOT warm-start gates (all lower-is-better by the _s suffix rule):
+    # cold = trace+compile in the first ticket's path, warm = pure
+    # deserialization — a warm first-result that regresses toward cold
+    # means the executable cache stopped working.
+    "serve_cold_first_result_s",
+    "serve_aot_first_result_s",
+    "serve_aot_deserialize_s",
 )
 
 

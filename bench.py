@@ -222,16 +222,27 @@ def _serve_phase(n: int) -> dict:
     policy = ServePolicy(max_batch=8, max_depth=max(64, 2 * n),
                          max_wait_s=0.005)
 
-    def burst(wal_path=None, wal_fsync="every-record"):
+    def burst(wal_path=None, wal_fsync="every-record", aot_dir=None):
         """One seeded burst through a fresh daemon; identical request
-        stream either way so the WAL-on/WAL-off delta isolates the
-        journal tax. Returns (summary, wall, oracle-mismatch count)."""
-        daemon = ServingDaemon(policy, wal_path=wal_path,
-                               wal_fsync=wal_fsync)
-        rng = np.random.default_rng(48)
+        stream every time so the WAL-on/off and AOT-cold/warm deltas
+        isolate the journal tax and the warm-start win respectively.
+        With ``aot_dir`` the cache attach + preload runs INSIDE the
+        timed window — a cold cache honestly pays its export builds
+        where a cold daemon would pay its traces. Returns (summary,
+        wall, oracle-mismatch count)."""
         shapes = ((48, 48), (64, 64))
         steps = (4, 8)
+        aot = None
         t0 = time.perf_counter()
+        if aot_dir is not None:
+            from mpi_and_open_mp_tpu.serve.aotcache import AOTCache
+
+            aot = AOTCache(aot_dir)
+        daemon = ServingDaemon(policy, wal_path=wal_path,
+                               wal_fsync=wal_fsync, aot_cache=aot)
+        if aot is not None:
+            aot.warm([(sh, "uint8") for sh in shapes], policy.max_batch)
+        rng = np.random.default_rng(48)
         for i in range(n):
             ny, nx = shapes[i % len(shapes)]
             daemon.submit((rng.random((ny, nx)) < 0.3).astype(np.uint8),
@@ -300,6 +311,33 @@ def _serve_phase(n: int) -> dict:
     if wbad:
         fields["serve_wal_error"] = (
             f"parity check failed on {wbad} resolved boards (WAL run)")
+
+    # The warm-start win, measured the honest way: the SAME burst twice
+    # over one cache directory. Burst 1 is the cold process (exports and
+    # persists every bucket program inside its timed window); burst 2 is
+    # the simulated restart (fresh AOTCache = fresh deserialize, like a
+    # requeued daemon). cold_first_result_s is the ISSUE's headline:
+    # construction -> first resolved ticket, where trace+compile lands.
+    # Baseline serve_* fields above stay AOT-OFF (and WAL-OFF) so the
+    # sentinel's history keys don't silently change meaning.
+    with tempfile.TemporaryDirectory(prefix="momp-bench-aot-") as td:
+        cs, cwall, cbad = burst(aot_dir=td)
+        hs, hwall, hbad = burst(aot_dir=td)
+    fields.update({
+        "serve_cold_first_result_s": cs.get("cold_first_result_s"),
+        "serve_aot_first_result_s": hs.get("cold_first_result_s"),
+        "serve_aot_hits": hs["aot_hits"],
+        "serve_aot_misses": hs["aot_misses"],
+        "serve_aot_deserialize_s": hs["aot_deserialize_s"],
+        "serve_aot_build_s": cs["aot_build_s"],
+        "serve_aot_engines": hs["engines"],
+        "serve_aot_p99_latency_s": hs["p99_latency_s"],
+        "serve_aot_parity": cbad == 0 and hbad == 0,
+    })
+    if cbad or hbad:
+        fields["serve_aot_error"] = (
+            f"parity check failed on {cbad + hbad} resolved boards "
+            "(AOT cold/warm runs)")
     return fields
 
 
@@ -334,8 +372,12 @@ def main(argv=None) -> int:
                     "shed/degrade counts on the JSON line, then the same "
                     "burst again under the every-record write-ahead "
                     "journal to price the durability tax (serve_wal_* "
-                    "fields incl. p50/p99 delta; runs on every backend; "
-                    "honors MOMP_CHAOS)")
+                    "fields incl. p50/p99 delta), then a cold/warm pair "
+                    "over one durable AOT executable cache to price the "
+                    "warm-start win (serve_cold_first_result_s vs "
+                    "serve_aot_first_result_s + hit/miss/deserialize "
+                    "accounting; runs on every backend; honors "
+                    "MOMP_CHAOS)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="write obs span/event JSONL here (sets MOMP_TRACE; "
                     "summarise with analysis/trace_report.py). The timed "
@@ -415,8 +457,13 @@ def _bench(args, state) -> int:
     from mpi_and_open_mp_tpu.robust import guards, watchdog
 
     backend_note = {}
+    # One knob for the whole fleet: GRAFT_PROBE_TIMEOUT_S (the graft
+    # driver's watchdog budget — __graft_entry__.dryrun_multichip) is the
+    # default; BENCH_PROBE_TIMEOUT_S still wins when set, so bench can be
+    # tuned independently without forking the harness config.
     res = watchdog.probe_devices(
-        _env_num("BENCH_PROBE_TIMEOUT_S", 240, float),
+        _env_num("BENCH_PROBE_TIMEOUT_S",
+                 _env_num("GRAFT_PROBE_TIMEOUT_S", 240, float), float),
         attempts=_env_num("BENCH_PROBE_ATTEMPTS", 1, int),
         backoff_s=_env_num("BENCH_PROBE_BACKOFF_S", 2.0, float),
         probe=_probe_devices,  # the module attribute — tests stub it

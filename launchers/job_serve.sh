@@ -14,7 +14,8 @@
 # Usage:
 #   launchers/job_serve.sh [--requests=N] [--max-batch=B] [--shapes=S]
 #                          [--checkpoint=PATH] [--wal=PATH]
-#                          [--wal-fsync=POLICY] [--seed=K]
+#                          [--wal-fsync=POLICY] [--aot-cache=DIR]
+#                          [--seed=K]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -24,6 +25,7 @@ SHAPES=48x48,64x64
 CKPT=/tmp/momp_serve_queue.state
 WAL=/tmp/momp_serve.wal
 WALFSYNC=every-record
+AOTDIR="${MOMP_AOT_CACHE:-/tmp/momp_serve_aot}"
 SEED=0
 for arg in "$@"; do
   case "$arg" in
@@ -33,6 +35,7 @@ for arg in "$@"; do
     --checkpoint=*) CKPT="${arg#*=}" ;;
     --wal=*)        WAL="${arg#*=}" ;;
     --wal-fsync=*)  WALFSYNC="${arg#*=}" ;;
+    --aot-cache=*)  AOTDIR="${arg#*=}" ;;
     --seed=*)       SEED="${arg#*=}" ;;
     *) echo "unknown arg: $arg" >&2; exit 2 ;;
   esac
@@ -42,15 +45,19 @@ if [ -s "$WAL" ] || [ -f "$CKPT" ]; then
   echo "serve state survives ($WAL / $CKPT); resuming drained tickets" >&2
   python -m mpi_and_open_mp_tpu.serve.daemon \
     --requests 0 --resume --wal "$WAL" --wal-fsync "$WALFSYNC" \
-    --checkpoint "$CKPT" --verify
+    --aot-cache "$AOTDIR" --checkpoint "$CKPT" --verify
 else
   python -m mpi_and_open_mp_tpu.serve.daemon \
     --requests "$REQUESTS" --shapes "$SHAPES" --max-batch "$MAXBATCH" \
     --seed "$SEED" --wal "$WAL" --wal-fsync "$WALFSYNC" \
-    --checkpoint "$CKPT" --verify
+    --aot-cache "$AOTDIR" --checkpoint "$CKPT" --verify
 fi
 # Only reached on a clean drain (set -e; a preempted pass exits 75
 # above, a killed pass never gets here): drop the consumed state —
-# journal, its compaction snapshots, and checkpoint — so the next
-# invocation starts a fresh burst instead of re-serving resolved work.
-rm -f "$CKPT" "$WAL" "$WAL".snap.* "$WAL".corrupt
+# journal, its compaction snapshots, checkpoint, and any stamped
+# quarantine copies — so the next invocation starts a fresh burst
+# instead of re-serving resolved work. The AOT cache is deliberately
+# KEPT: executables are state-free and fingerprint-keyed, and a warm
+# cache is the whole point — the next burst's first ticket must not
+# pay a trace+compile.
+rm -f "$CKPT" "$WAL" "$WAL".snap.* "$WAL".corrupt*

@@ -49,6 +49,15 @@ Tokens:
     journaled). The write-ahead journal's crash-matrix test drives all
     three to prove the per-fsync-policy loss bounds in
     ``serve/wal.py``.
+``aot_corrupt=<kind>:<k>``
+    Damage the first ``<k>`` AOT-cache artifacts ON DISK immediately
+    after their crash-atomic save (:func:`take_aot_corrupt` consumes the
+    budget). Kinds: ``bitflip`` (one payload byte flipped — the CRC
+    catches it on the next load, the ``aot:corrupt`` quarantine path) and
+    ``skew`` (envelope rewritten with a fake jax version in the stored
+    fingerprint — valid CRC, exercises the key-stale rejection). The
+    in-memory program the saving process holds stays good, so the fault
+    lands where real bit rot does: in the NEXT process's warm resume.
 ``seed=<int>``
     Seed for corrupted-value generation (default 0).
 ``noguard``
@@ -80,6 +89,9 @@ CRASH_SITES = ("post-admit", "mid-frame", "post-dispatch")
 #: or CI harness cannot tell an injected crash from a real ``kill -9``.
 CRASH_EXIT = 137
 
+#: Artifact-damage modes for the ``aot_corrupt=<kind>:<k>`` token.
+AOT_CORRUPT_KINDS = ("bitflip", "skew")
+
 
 @dataclasses.dataclass
 class FaultPlan:
@@ -98,6 +110,9 @@ class FaultPlan:
     crash_site: str | None = None  # instrumented site to hard-kill at
     crash_at: int = 0  # 1-based arrival count that fires the kill
     crash_hits: int = 0  # runtime arrivals counted so far
+    aot_corrupt_kind: str | None = None  # "bitflip" | "skew"
+    aot_corrupt: int = 0  # total artifact saves to damage
+    aot_corrupted: int = 0  # runtime count consumed so far
 
     @classmethod
     def parse(cls, raw: str) -> "FaultPlan":
@@ -132,6 +147,14 @@ class FaultPlan:
                     plan.crash_at = int(k) if k else 1
                     if plan.crash_at < 1:
                         raise ValueError("crash count must be >= 1")
+                elif key == "aot_corrupt":
+                    kind, _, k = val.partition(":")
+                    if kind not in AOT_CORRUPT_KINDS:
+                        raise ValueError(f"want one of {AOT_CORRUPT_KINDS}")
+                    plan.aot_corrupt_kind = kind
+                    plan.aot_corrupt = int(k) if k else 1
+                    if plan.aot_corrupt < 1:
+                        raise ValueError("aot_corrupt count must be >= 1")
                 elif key == "seed":
                     plan.seed = int(val)
                 elif key == "noguard" and not val:
@@ -272,6 +295,19 @@ def take_serve_fault() -> bool:
         return False
     plan.serve_failed += 1
     return True
+
+
+def take_aot_corrupt() -> str | None:
+    """Consume one artifact-damage fault from the plan's ``aot_corrupt``
+    budget: the kind (``"bitflip"``/``"skew"``) to apply to the artifact
+    just saved, or ``None``. Stateful like :func:`take_serve_fault` —
+    the first ``k`` saves are damaged, every later one stays clean — and
+    inert when no plan is active or injection is :func:`suppressed`."""
+    plan = active_plan()
+    if plan is None or plan.aot_corrupted >= plan.aot_corrupt:
+        return None
+    plan.aot_corrupted += 1
+    return plan.aot_corrupt_kind
 
 
 def crash_armed(site: str) -> bool:

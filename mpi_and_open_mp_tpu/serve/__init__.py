@@ -23,6 +23,14 @@ shape, verified idle via the ``jit.retrace`` counters
 (``obs.metrics.get("jit.retrace", fn="life_batch_...")`` — the PR-4
 observability layer ticks them inside each batched jit body, once per
 compile).
+
+That small closed program set is also what makes the programs
+*persistable*: ``serve.aotcache`` serializes every bucket executable
+through ``jax.export`` into a durable on-disk cache, so a restarted
+daemon deserializes in milliseconds instead of re-tracing — zero
+``jit.retrace`` ticks on a warm resume, with corrupt/stale artifacts
+quarantined and parity-gated so a bad cache can only ever cost a fresh
+trace, never a wrong answer.
 """
 
 from mpi_and_open_mp_tpu.serve.batcher import (  # noqa: F401
@@ -48,4 +56,5 @@ from mpi_and_open_mp_tpu.serve.wal import (  # noqa: F401
     WALReplay,
     replay,
 )
+from mpi_and_open_mp_tpu.serve.aotcache import AOTCache  # noqa: F401
 from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon  # noqa: F401

@@ -1,0 +1,421 @@
+"""Durable AOT executable cache: millisecond warm resume for the daemon.
+
+PR 7/8 made the serving daemon's *state* survive anything (drain
+checkpoint, write-ahead ticket journal); this module does the same for
+its *programs*. The daemon's compiled-program set is small and closed —
+one program per (board shape, dtype) x power-of-two batch bucket, at
+most ``log2(max_batch)+1`` buckets per shape, with the step count a
+runtime scalar on every engine path — so the whole set serializes
+through ``jax.export`` into a handful of on-disk artifacts, and a
+requeued/resumed daemon deserializes them in milliseconds instead of
+re-tracing its first batch into the restored tickets' p99. This is the
+compilation analogue of PAPERS.md's persistent MPI requests: plan and
+compile once, persist the fixed schedule, reuse it across every
+restart. The proof instrument is the ``jit.retrace{fn=life_batch_*}``
+counter set: a deserialized program's ``Exported.call`` never re-runs
+the traced Python bodies, so a warm resume shows ZERO retraces.
+
+**Keying: a fingerprint, not a filename convention.** Every artifact is
+keyed by the full fingerprint of what made the program: stack shape,
+dtype, the steps signature (runtime int32 scalar), batch bucket, the
+engine path ``native_path_batch`` would pick, jax/jaxlib versions,
+platform/device kind/topology, and a content hash of the engine source
+files (``ops/bitlife.py`` + ``ops/pallas_life.py``). The digest of that
+fingerprint is the filename; the fingerprint itself is stored INSIDE
+the envelope and re-verified on load, so a stale artifact (upgraded
+jax, edited kernels, different chip) can never be executed — it is
+*key-stale*, quarantined, and rebuilt.
+
+**Hardened like the WAL, not like a cache.** Artifacts use the repo's
+crash-atomic envelope discipline (``MOMP-AOT/1`` magic + ``>QI``
+length/CRC32 header + payload, written tmp+fsync+``os.replace``+parent
+dir fsync — the exact ``utils/checkpoint.py`` frame). A corrupt,
+truncated, or key-stale artifact is quarantined to a
+generation-stamped ``.corrupt.*``/``.stale.*`` sibling
+(:func:`utils.checkpoint.quarantine` — forensics preserved, never
+clobbered) and the daemon falls back to a fresh trace with
+``aot:miss``/``aot:corrupt`` provenance; every deserialized executable
+is additionally oracle parity-gated on its first use, so even a
+CRC-valid artifact that computes wrong answers is caught, quarantined,
+and recovered from through the guards ladder. A bad cache can never
+crash or wrong-answer the daemon. ``MOMP_CHAOS aot_corrupt=<kind>:<k>``
+(kinds: ``bitflip``, ``skew``) corrupts artifacts at save time so both
+failure modes are drilled deterministically, in-process and in the CI
+``serve-warm-resume`` job.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+import zlib
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
+
+AOT_MAGIC = b"MOMP-AOT/1\n"
+_HEADER = struct.Struct(">QI")  # payload length, CRC32
+
+#: The steps calling convention every cached program shares: one int32
+#: runtime scalar, so one program per stack shape serves any step count.
+STEPS_SIGNATURE = "runtime-scalar-int32"
+
+_CODE_FP = None
+
+
+class ArtifactError(ValueError):
+    """A cache artifact that must not be executed. ``kind`` is the
+    provenance bucket: ``"corrupt"`` (bad magic/length/CRC/undecodable
+    payload/undeserializable blob) or ``"stale"`` (intact envelope whose
+    stored fingerprint doesn't match this process — version skew, edited
+    kernels, different silicon)."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+class ParityError(RuntimeError):
+    """A deserialized executable whose first result diverged from the
+    NumPy oracle — raised from the dispatch rung so the guards ladder
+    recovers through a fresh trace."""
+
+
+def code_fingerprint() -> str:
+    """Content hash of the engine sources the cached programs compile
+    from. Editing a kernel invalidates every artifact it produced —
+    correctness beats cache hits."""
+    global _CODE_FP
+    if _CODE_FP is None:
+        from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+        h = hashlib.sha256()
+        for mod in (bitlife, pallas_life):
+            with open(mod.__file__, "rb") as fd:
+                h.update(fd.read())
+        _CODE_FP = h.hexdigest()[:16]
+    return _CODE_FP
+
+
+def fingerprint(stack_shape: tuple[int, int, int], dtype) -> dict:
+    """The full cache key for one bucket program — everything that can
+    change the compiled executable or its validity."""
+    import jax
+    import jaxlib
+
+    from mpi_and_open_mp_tpu.ops import pallas_life
+
+    b, ny, nx = (int(x) for x in stack_shape)
+    on_tpu = jax.default_backend() == "tpu"
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — keying must not hang/crash
+        device_kind = "unknown"
+    return {
+        "schema": "momp-aot/1",
+        "shape": [ny, nx],
+        "dtype": str(np.dtype(dtype)),
+        "bucket": b,
+        "steps": STEPS_SIGNATURE,
+        "engine_path": "batch:" + pallas_life.native_path_batch(
+            (b, ny, nx), on_tpu=on_tpu),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": jax.default_backend(),
+        "device_kind": device_kind,
+        "topology": f"{jax.default_backend()}:{jax.device_count()}",
+        "code": code_fingerprint(),
+    }
+
+
+def digest_for(key: dict) -> str:
+    blob = json.dumps(key, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def bucket_sizes(max_batch: int) -> list[int]:
+    """Every batch size ``serve.batcher.bucket_batch_size`` can emit:
+    powers of two below ``max_batch`` plus ``max_batch`` itself — at
+    most ``log2(max_batch)+1`` programs per shape."""
+    sizes, b = [], 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(int(max_batch))
+    return sizes
+
+
+def save_artifact(path: str, key: dict, blob: bytes) -> None:
+    """Write one serialized executable crash-atomically (the
+    ``utils.checkpoint`` envelope + tmp/fsync/replace/dir-fsync dance).
+    An armed ``MOMP_CHAOS aot_corrupt=`` plan then damages the artifact
+    ON DISK, after the clean write — the in-memory program this process
+    already holds stays good, so the fault surfaces exactly where a real
+    bit rot would: in the NEXT process's load."""
+    from mpi_and_open_mp_tpu.robust import chaos
+
+    payload = pickle.dumps({"key": key, "blob": blob},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    framed = (AOT_MAGIC
+              + _HEADER.pack(len(payload), zlib.crc32(payload))
+              + payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fd:
+        fd.write(framed)
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    checkpoint_mod._fsync_dir(path)
+    kind = chaos.take_aot_corrupt()
+    if kind == "bitflip":
+        with open(path, "r+b") as fd:
+            fd.seek(len(framed) // 2)
+            byte = fd.read(1)
+            fd.seek(len(framed) // 2)
+            fd.write(bytes([byte[0] ^ 0x40]))
+    elif kind == "skew":
+        skewed = dict(key, jax="0.0.0-chaos-skew")
+        payload = pickle.dumps({"key": skewed, "blob": blob},
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        with open(path, "wb") as fd:
+            fd.write(AOT_MAGIC
+                     + _HEADER.pack(len(payload), zlib.crc32(payload))
+                     + payload)
+
+
+def load_artifact(path: str, want_key: dict):
+    """Read one artifact back, fully validated BEFORE deserialization:
+    magic, header, length, CRC, payload decode, then the stored
+    fingerprint against ``want_key`` (an intact envelope built by a
+    different jax/kernel/silicon is ``stale``, not loadable). Returns
+    the ``jax.export.Exported``; raises :class:`ArtifactError`."""
+    from jax import export as jax_export
+
+    try:
+        with open(path, "rb") as fd:
+            framed = fd.read()
+    except OSError as e:
+        raise ArtifactError(
+            "corrupt", f"unreadable AOT artifact at {path} "
+            f"({type(e).__name__}: {e})") from e
+    head = len(AOT_MAGIC) + _HEADER.size
+    if not framed.startswith(AOT_MAGIC):
+        raise ArtifactError(
+            "corrupt", f"AOT artifact at {path} has a bad magic header — "
+            "not a MOMP-AOT/1 file (or corrupted at offset 0)")
+    if len(framed) < head:
+        raise ArtifactError(
+            "corrupt", f"AOT artifact at {path} is truncated inside its "
+            f"header ({len(framed)} of {head} header bytes)")
+    length, want_crc = _HEADER.unpack(framed[len(AOT_MAGIC):head])
+    payload = framed[head:]
+    if len(payload) != length:
+        raise ArtifactError(
+            "corrupt", f"AOT artifact at {path} is truncated: payload is "
+            f"{len(payload)} bytes, header promises {length}")
+    if zlib.crc32(payload) != want_crc:
+        raise ArtifactError(
+            "corrupt", f"AOT artifact at {path} failed its CRC "
+            f"(stored {want_crc:#010x}, recomputed "
+            f"{zlib.crc32(payload):#010x}) — the file is corrupt")
+    try:
+        doc = pickle.loads(payload)
+        stored_key, blob = doc["key"], doc["blob"]
+    except Exception as e:  # noqa: BLE001 — any decode failure
+        raise ArtifactError(
+            "corrupt", f"AOT artifact at {path} passed its CRC but failed "
+            f"to decode ({type(e).__name__}: {e})"[:400]) from e
+    if stored_key != want_key:
+        drift = sorted(k for k in set(stored_key) | set(want_key)
+                       if stored_key.get(k) != want_key.get(k))
+        raise ArtifactError(
+            "stale", f"AOT artifact at {path} is key-stale (fields "
+            f"drifted: {drift}) — built by a different "
+            "jax/kernel/silicon; rebuilding")
+    try:
+        return jax_export.deserialize(blob)
+    except Exception as e:  # noqa: BLE001 — a blob only jax can judge
+        raise ArtifactError(
+            "corrupt", f"AOT artifact at {path} failed jax.export "
+            f"deserialization ({type(e).__name__}: {e})"[:400]) from e
+
+
+def _bucket_program(boards, steps):
+    # The exact program the daemon's primary rung dispatches: the
+    # batched native-path dispatcher with the step count flowing through
+    # as a runtime scalar.
+    from mpi_and_open_mp_tpu.ops import pallas_life
+
+    return pallas_life.life_run_vmem_batch(boards, steps)
+
+
+class AOTCache:
+    """On-disk + in-memory store of the daemon's bucket executables.
+
+    ``ensure`` is the one entry point the dispatch path uses: in-memory
+    program, else load-from-disk (hit), else build+persist (miss); a
+    bad artifact is quarantined and rebuilt. Every outcome lands in
+    ``stats()`` (the daemon CLI/bench fields), the metrics registry
+    (``serve.aot{status=...}``), and the trace stream — cold starts and
+    cache rot are observable, never silent. Any cache-side failure
+    degrades to ``(digest, None, "error")``: the daemon then simply
+    serves through its normal trace-and-compile ladder."""
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = os.path.abspath(os.fspath(root))
+        os.makedirs(self.root, exist_ok=True)
+        self._programs: dict[str, object] = {}
+        self._verified: set[str] = set()
+        self._stats = {"hits": 0, "misses": 0, "corrupt": 0, "stale": 0,
+                       "parity_failed": 0, "built": 0, "errors": 0,
+                       "deserialize_s": 0.0, "build_s": 0.0}
+
+    # -- accounting --------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = dict(self._stats)
+        out["deserialize_s"] = round(out["deserialize_s"], 6)
+        out["build_s"] = round(out["build_s"], 6)
+        out["programs"] = len(self._programs)
+        return out
+
+    def _note(self, status: str, **fields) -> None:
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        metrics.inc("serve.aot", status=status)
+        trace.event("serve.aot", status=status, **fields)
+
+    # -- the dispatch-path entry point -------------------------------------
+
+    def ensure(self, stack_shape, dtype) -> tuple[str, object, str]:
+        """``(digest, exported_or_None, status)`` for one bucket program.
+
+        ``status``: ``"memory"`` (already resident), ``"hit"``
+        (deserialized from disk), ``"miss"`` (no artifact — freshly
+        traced, exported, and persisted for the next process),
+        ``"corrupt"``/``"stale"`` (bad artifact quarantined, then a
+        fresh build — the ``aot:corrupt`` provenance path), ``"error"``
+        (cache unavailable; ``exported`` is None and the caller serves
+        without it)."""
+        try:
+            key = fingerprint(stack_shape, dtype)
+            digest = digest_for(key)
+        except Exception as e:  # noqa: BLE001 — keying must not kill serve
+            self._stats["errors"] += 1
+            self._note("error", error=f"{type(e).__name__}: {e}"[:200])
+            return "", None, "error"
+        if digest in self._programs:
+            return digest, self._programs[digest], "memory"
+        path = os.path.join(self.root, digest + ".aot")
+        status = "miss"
+        if os.path.exists(path):
+            t0 = time.perf_counter()
+            try:
+                exp = load_artifact(path, key)
+            except ArtifactError as e:
+                status = e.kind  # "corrupt" | "stale"
+                self._stats[e.kind] += 1
+                quarantined = checkpoint_mod.quarantine(path, label=e.kind)
+                self._note(e.kind, digest=digest,
+                           quarantined=quarantined or "",
+                           error=str(e)[:200])
+            else:
+                self._stats["hits"] += 1
+                self._stats["deserialize_s"] += time.perf_counter() - t0
+                self._note("hit", digest=digest)
+                self._programs[digest] = exp
+                return digest, exp, "hit"
+        if status == "miss":
+            self._stats["misses"] += 1
+            self._note("miss", digest=digest)
+        # Fresh trace: build the program this process needs anyway, and
+        # persist it so the NEXT process resumes warm.
+        t0 = time.perf_counter()
+        try:
+            exp = self._build(stack_shape, dtype)
+            self._stats["build_s"] += time.perf_counter() - t0
+            self._stats["built"] += 1
+            save_artifact(path, key, exp.serialize())
+        except Exception as e:  # noqa: BLE001 — never crash the daemon
+            self._stats["errors"] += 1
+            self._note("error", digest=digest,
+                       error=f"{type(e).__name__}: {e}"[:200])
+            return digest, None, "error"
+        self._programs[digest] = exp
+        return digest, exp, status
+
+    def _build(self, stack_shape, dtype):
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
+        args = (jax.ShapeDtypeStruct(tuple(stack_shape), np.dtype(dtype)),
+                jax.ShapeDtypeStruct((), jnp.int32))
+        return jax_export.export(jax.jit(_bucket_program))(*args)
+
+    def warm(self, boards, max_batch: int) -> dict:
+        """The preload phase: ensure every bucket program for the given
+        ``(shape, dtype)`` pairs across all power-of-two buckets up to
+        ``max_batch`` — on a warm cache this is pure deserialization
+        (milliseconds); on a cold one it is the plan/compile-once pass
+        whose artifacts make every later restart warm. Returns the
+        stats delta for this pass."""
+        before = dict(self._stats)
+        seen = set()
+        for shape, dtype in boards:
+            ny, nx = (int(x) for x in shape)
+            for b in bucket_sizes(max_batch):
+                sig = (b, ny, nx, str(np.dtype(dtype)))
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                self.ensure((b, ny, nx), dtype)
+        out = {k: (round(self._stats[k] - before[k], 6)
+                   if isinstance(before[k], float)
+                   else self._stats[k] - before[k])
+               for k in before}
+        out["programs"] = len(seen)
+        return out
+
+    # -- verified execution ------------------------------------------------
+
+    def call_verified(self, digest: str, stack: np.ndarray, steps: int):
+        """Run one resident program, oracle parity-gating its FIRST
+        result per process: a deserialized executable earns trust by
+        reproducing the NumPy oracle bit-exactly once, after which the
+        per-dispatch validator (shape + value range) suffices. A parity
+        failure quarantines the on-disk artifact, evicts the program,
+        and raises :class:`ParityError` — the guards ladder then
+        recovers through a fresh trace."""
+        import jax.numpy as jnp
+
+        from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+
+        exp = self._programs[digest]
+        out = np.asarray(exp.call(jnp.asarray(stack),
+                                  jnp.int32(int(steps))))
+        if digest not in self._verified:
+            ref = np.array(stack, copy=True)
+            for b in range(ref.shape[0]):
+                board = ref[b]
+                for _ in range(int(steps)):
+                    board = life_step_numpy(board)
+                ref[b] = board
+            if not np.array_equal(out, ref):
+                self._stats["parity_failed"] += 1
+                self._programs.pop(digest, None)
+                path = os.path.join(self.root, digest + ".aot")
+                quarantined = (checkpoint_mod.quarantine(path)
+                               if os.path.exists(path) else None)
+                self._note("parity_failed", digest=digest,
+                           quarantined=quarantined or "")
+                raise ParityError(
+                    f"AOT program {digest} diverged from the NumPy oracle "
+                    "on first use — artifact quarantined")
+            self._verified.add(digest)
+        return out

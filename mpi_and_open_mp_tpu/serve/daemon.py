@@ -83,6 +83,7 @@ class ServingDaemon:
                  wal_path: str | None = None,
                  wal_fsync: str = "every-record",
                  wal_compact_bytes: int = 1 << 20,
+                 aot_cache=None,
                  clock=time.monotonic, sleep=time.sleep):
         self.policy = policy or ServePolicy()
         self.queue = ServeQueue(self.policy)
@@ -92,6 +93,13 @@ class ServingDaemon:
         self._batches = 0
         self._retries = 0
         self._degraded = 0
+        # Durable program store (serve.aotcache.AOTCache) — when set, the
+        # dispatch ladder gets an `aot:*` top rung and resume preloads the
+        # bucket executables, so the first restored ticket never waits on
+        # a trace+compile. None = every dispatch traces as before.
+        self._aot = aot_cache
+        self._created_at = self._clock()
+        self._first_result_s: float | None = None  # cold-start latency
         # The journal's "one chunk" loss bound under every-chunk is
         # literal: the buffer never holds more records than one dispatch
         # batch admits.
@@ -160,7 +168,18 @@ class ServingDaemon:
         idempotent. After a WAL resume the journal is immediately
         compacted: the restored tickets carry NEW ids in this process,
         and rotation re-anchors the journal on them (also discarding any
-        torn tail so fresh frames never sit behind garbage)."""
+        torn tail so fresh frames never sit behind garbage).
+
+        Corrupt artifacts on EITHER durable rung quarantine to a
+        generation-stamped ``.corrupt.<stamp>`` sibling
+        (``utils.checkpoint.quarantine``) and the ladder falls through —
+        a second corrupt resume gets its own forensic copy, never
+        clobbering the first, and a rotten checkpoint degrades to a
+        fresh daemon instead of a crash. With an ``aot_cache`` in
+        ``**kw``, every rung ends in a preload phase: the bucket
+        executables for the restored pending set deserialize (or build)
+        BEFORE the first dispatch, so warm-resume p99 never eats a
+        trace+compile (``detail["aot_preload"]``)."""
         from mpi_and_open_mp_tpu.obs import trace
 
         detail: dict = {}
@@ -170,13 +189,12 @@ class ServingDaemon:
             except ValueError as e:
                 detail["wal_error"] = str(e)[:300]
                 trace.event("serve.resume.wal_error", error=str(e)[:200])
-                # Quarantine the unreadable journal (forensics intact):
-                # appending fresh frames behind a bad head would poison
-                # every future replay too.
-                try:
-                    os.replace(wal_path, wal_path + ".corrupt")
-                except OSError:
-                    pass
+                # Quarantine the unreadable journal (forensics intact,
+                # uniquely stamped): appending fresh frames behind a bad
+                # head would poison every future replay too.
+                q = checkpoint_mod.quarantine(wal_path)
+                if q:
+                    detail["wal_quarantine"] = q
             else:
                 daemon = cls(policy, checkpoint_path=checkpoint_path,
                              wal_path=wal_path, wal_fsync=wal_fsync, **kw)
@@ -197,15 +215,47 @@ class ServingDaemon:
                 detail["wal_replay"] = rep.counts()
                 trace.event("serve.resume", source="wal",
                             tickets=len(rep.pending))
+                daemon._aot_preload(detail)
                 return daemon, "wal", detail
         if checkpoint_path and os.path.exists(checkpoint_path):
-            daemon = cls.resume(checkpoint_path, policy, wal_path=wal_path,
-                                wal_fsync=wal_fsync, **kw)
-            return daemon, "checkpoint", detail
+            try:
+                daemon = cls.resume(checkpoint_path, policy,
+                                    wal_path=wal_path,
+                                    wal_fsync=wal_fsync, **kw)
+            except ValueError as e:
+                # Same contract as the WAL rung: a corrupt/skewed drain
+                # checkpoint is quarantined (stamped — the forensic copy
+                # of an earlier corrupt resume survives) and the ladder
+                # falls through to fresh rather than refusing to serve.
+                detail["checkpoint_error"] = str(e)[:300]
+                trace.event("serve.resume.checkpoint_error",
+                            error=str(e)[:200])
+                q = checkpoint_mod.quarantine(checkpoint_path)
+                if q:
+                    detail["checkpoint_quarantine"] = q
+            else:
+                daemon._aot_preload(detail)
+                return daemon, "checkpoint", detail
         daemon = cls(policy, checkpoint_path=checkpoint_path,
                      wal_path=wal_path, wal_fsync=wal_fsync, **kw)
         trace.event("serve.resume", source="fresh", tickets=0)
         return daemon, "fresh", detail
+
+    def _aot_preload(self, detail: dict | None = None) -> dict | None:
+        """Warm the AOT cache for every (shape, dtype) currently pending:
+        all power-of-two bucket programs up to ``max_batch`` are resident
+        before the first dispatch. No-op without a cache or pending work;
+        returns (and records in ``detail``) the warm-pass stats."""
+        if self._aot is None:
+            return None
+        boards = {(t.board.shape, str(np.asarray(t.board).dtype))
+                  for t in self.queue.pending()}
+        if not boards:
+            return None
+        summary = self._aot.warm(sorted(boards), self.policy.max_batch)
+        if detail is not None:
+            detail["aot_preload"] = summary
+        return summary
 
     # -- the supervised loop ----------------------------------------------
 
@@ -319,17 +369,40 @@ class ServingDaemon:
 
     def _engines(self, stack: np.ndarray, steps: int):
         """The graceful-degradation ladder for one padded chunk, ranked:
-        the batched native path (Pallas/VMEM on TPU, vmapped XLA off it),
-        then the always-compilable vmapped XLA bit engine, then the NumPy
-        oracle — the one engine that needs no device at all. Fallback
-        engines run under ``chaos.suppressed()`` so a recovery dispatch
-        cannot be re-failed by the fault that triggered it."""
+        the durable AOT executable (when a cache is attached — a
+        deserialized ``jax.export`` program that runs with ZERO
+        retraces, oracle parity-gated on first use), then the batched
+        native path (Pallas/VMEM on TPU, vmapped XLA off it), then the
+        always-compilable vmapped XLA bit engine, then the NumPy oracle
+        — the one engine that needs no device at all. The AOT rung's
+        stamp carries its cache provenance: ``aot:<path>`` on a
+        hit/resident program, ``aot:<path>:miss`` /
+        ``aot:<path>:corrupt`` / ``aot:<path>:stale`` when this dispatch
+        had to build fresh (a bad artifact was quarantined first).
+        Fallback engines run under ``chaos.suppressed()`` so a recovery
+        dispatch cannot be re-failed by the fault that triggered it."""
         import jax
 
         from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
 
         path = pallas_life.native_path_batch(
             stack.shape, on_tpu=jax.default_backend() == "tpu")
+
+        rungs = []
+        if self._aot is not None:
+            digest, exported, status = self._aot.ensure(
+                stack.shape, stack.dtype)
+            if exported is not None:
+                stamp = (f"aot:{path}" if status in ("memory", "hit")
+                         else f"aot:{path}:{status}")
+
+                def aot():
+                    if chaos.take_serve_fault():
+                        raise RuntimeError(
+                            "chaos: injected serve dispatch fault")
+                    return self._aot.call_verified(digest, stack, steps)
+
+                rungs.append((stamp, aot))
 
         def native():
             import jax.numpy as jnp
@@ -359,8 +432,9 @@ class ServingDaemon:
                     out[b] = board
                 return out
 
-        return [(f"batch:{path}", native), ("batch:xla", xla),
-                ("oracle", oracle)]
+        rungs += [(f"batch:{path}", native), ("batch:xla", xla),
+                  ("oracle", oracle)]
+        return rungs
 
     def _dispatch_chunk(self, chunk: list[Ticket]) -> None:
         from mpi_and_open_mp_tpu.obs import metrics, trace
@@ -452,6 +526,12 @@ class ServingDaemon:
             self._wal.resolve([t.id for t in live], engine=stamp)
         for i, t in enumerate(live):
             self.queue.resolve(t, host[i], stamp, now)
+        if self._first_result_s is None:
+            # Cold-start latency: daemon construction to the first
+            # resolved result — the number the AOT cache exists to crush
+            # (trace+compile lands here on a cold resume, pure
+            # deserialization on a warm one).
+            self._first_result_s = now - self._created_at
         self._batches += 1
         metrics.inc("serve.batches")
         if padded > len(live):
@@ -481,8 +561,21 @@ class ServingDaemon:
             "p50_latency_s": round(percentile(lat, 50), 6),
             "p99_latency_s": round(percentile(lat, 99), 6),
         }
+        if self._first_result_s is not None:
+            out["cold_first_result_s"] = round(self._first_result_s, 6)
         if self._wal is not None:
             out["wal"] = self._wal.stats()
+        if self._aot is not None:
+            s = self._aot.stats()
+            out["aot"] = s
+            # Flat copies of the fields the bench line and the
+            # regression sentinel watch.
+            out["aot_hits"] = s["hits"]
+            out["aot_misses"] = s["misses"]
+            out["aot_corrupt"] = s["corrupt"]
+            out["aot_stale"] = s["stale"]
+            out["aot_deserialize_s"] = s["deserialize_s"]
+            out["aot_build_s"] = s["build_s"]
         return out
 
 
@@ -528,6 +621,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "batch of records on power cut; off = page-cache "
                    "only (still zero loss on process death; default "
                    "%(default)s)")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="durable AOT executable cache directory (default "
+                   "$MOMP_AOT_CACHE): bucket programs persist as "
+                   "jax.export artifacts, so a restarted daemon "
+                   "deserializes instead of re-tracing — warm resume "
+                   "shows zero jit.retrace{fn=life_batch_*} ticks; a "
+                   "corrupt/stale artifact quarantines and falls back "
+                   "to a fresh trace (aot:corrupt provenance)")
     p.add_argument("--resume", action="store_true",
                    help="restore drained tickets before serving the "
                    "(possibly empty) new burst — WAL replay first, then "
@@ -539,11 +640,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _burst(daemon: ServingDaemon, args) -> None:
+def _parse_shapes(spec: str) -> list[tuple[int, int]]:
     shapes = []
-    for tok in args.shapes.split(","):
+    for tok in spec.split(","):
         ny, _, nx = tok.strip().partition("x")
         shapes.append((int(ny), int(nx)))
+    return shapes
+
+
+def _burst(daemon: ServingDaemon, args) -> None:
+    shapes = _parse_shapes(args.shapes)
     steps = [int(s) for s in args.steps.split(",")]
     rng = np.random.default_rng(args.seed)
     for i in range(args.requests):
@@ -572,23 +678,40 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.resume and not (args.checkpoint or args.wal):
         build_parser().error("--resume requires --checkpoint and/or --wal")
+    aot_dir = args.aot_cache or os.environ.get("MOMP_AOT_CACHE") or None
+    aot = None
+    if aot_dir:
+        from mpi_and_open_mp_tpu.serve.aotcache import AOTCache
+
+        aot = AOTCache(aot_dir)
+        rec_aot_cache = os.path.abspath(aot_dir)
     policy = ServePolicy(
         max_batch=args.max_batch, max_depth=args.max_depth,
         max_wait_s=args.max_wait, request_timeout_s=args.timeout,
         max_retries=args.retries, seed=args.seed)
     rec: dict = {"daemon": "serve", "resume": bool(args.resume)}
+    if aot is not None:
+        rec["aot_cache"] = rec_aot_cache
     try:
         if args.resume:
             daemon, source, detail = ServingDaemon.resume_any(
                 wal_path=args.wal, checkpoint_path=args.checkpoint,
-                policy=policy, wal_fsync=args.wal_fsync)
+                policy=policy, wal_fsync=args.wal_fsync, aot_cache=aot)
             rec["resume_source"] = source
             rec.update(detail)
             rec["resumed_tickets"] = daemon.queue.depth()
         else:
             daemon = ServingDaemon(
                 policy, checkpoint_path=args.checkpoint,
-                wal_path=args.wal, wal_fsync=args.wal_fsync)
+                wal_path=args.wal, wal_fsync=args.wal_fsync,
+                aot_cache=aot)
+        if aot is not None and args.requests > 0:
+            # Preload for the incoming burst too (the resume preload
+            # covered only already-pending shapes): every bucket program
+            # the burst can need is resident before the first dispatch.
+            rec["aot_warm"] = aot.warm(
+                [(s, "uint8") for s in _parse_shapes(args.shapes)],
+                policy.max_batch)
         _burst(daemon, args)
         t0 = time.perf_counter()
         daemon.serve()

@@ -56,6 +56,32 @@ def _fsync_dir(path: str | os.PathLike) -> None:
         os.close(fd)
 
 
+def quarantine(path: str | os.PathLike, label: str = "corrupt") -> str | None:
+    """Move a bad artifact aside as ``<path>.<label>.<stamp>`` and return
+    the destination (``None`` when the move failed or nothing was there).
+
+    The stamp (UTC time + pid + a collision counter) makes every
+    quarantine file unique: a second corrupt resume must never clobber
+    the forensic copy of the first — the evidence of TWO independent
+    corruptions is itself evidence. The rename is made durable with the
+    same parent-directory fsync as every other crash-atomic move here."""
+    import time
+
+    path = os.path.abspath(os.fspath(path))
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime()) + f".{os.getpid()}"
+    dst = f"{path}.{label}.{stamp}"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{path}.{label}.{stamp}.{n}"
+    try:
+        os.replace(path, dst)
+    except OSError:
+        return None
+    _fsync_dir(dst)
+    return dst
+
+
 def _checkpointer():
     """Module-cached PyTreeCheckpointer: constructing one spins up thread
     pools and a tensorstore context, too costly to pay per save inside the

@@ -317,6 +317,55 @@ def test_state_checkpoint_garbage_crc_and_missing(tmp_path):
         checkpoint.restore_state(tmp_path / "missing.state")
 
 
+def test_state_checkpoint_version_skew_fails_clean(tmp_path):
+    """A well-formed envelope from an UNKNOWN magic/version — the file a
+    future (or foreign) writer leaves behind — must raise the same clean
+    ValueError as garbage, never a pickle/struct traceback: the resume
+    ladder's quarantine-and-fall-through depends on that contract."""
+    import pickle
+    import struct
+    import zlib
+
+    import pytest
+
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    payload = pickle.dumps({"v": 2})
+    for magic in (b"MOMP-STATE/2\n", b"MOMP-STATE/9\n", b"OTHER-FMT/1\n"):
+        skew = tmp_path / f"skew-{magic[:4].decode()}.state"
+        skew.write_bytes(magic
+                         + struct.pack(">QI", len(payload),
+                                       zlib.crc32(payload))
+                         + payload)
+        with pytest.raises(ValueError, match="magic"):
+            checkpoint.restore_state(skew)
+
+
+def test_quarantine_unique_stamped_copies(tmp_path):
+    """utils.checkpoint.quarantine: every call moves the artifact to a
+    DISTINCT stamped sibling — repeated corruptions never clobber an
+    earlier forensic copy — and a missing source is a clean None."""
+    from mpi_and_open_mp_tpu.utils import checkpoint
+
+    src = tmp_path / "artifact.bin"
+    names = []
+    for i in range(3):
+        src.write_bytes(f"corruption #{i}".encode())
+        dst = checkpoint.quarantine(src)
+        assert dst is not None and not src.exists()
+        names.append(dst)
+    assert len(set(names)) == 3
+    contents = sorted(open(n, "rb").read() for n in names)
+    assert contents == [b"corruption #0", b"corruption #1",
+                        b"corruption #2"]
+    assert all(".corrupt." in n for n in names)
+
+    assert checkpoint.quarantine(src) is None  # nothing there
+    src.write_bytes(b"x")
+    labeled = checkpoint.quarantine(src, label="stale")
+    assert labeled is not None and ".stale." in labeled
+
+
 def test_checkpoint_resume_bitfused_padded_frame(tmp_path, make_board):
     """Mid-run checkpoint/resume through the packed path on an unaligned
     board: the stored state is the PADDED frame (mirror rows included);

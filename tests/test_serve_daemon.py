@@ -336,6 +336,34 @@ def test_resume_rejects_corrupt_checkpoint(tmp_path):
         ServingDaemon.resume(str(tmp_path / "missing.state"))
 
 
+def test_resume_any_quarantines_corrupt_checkpoint_and_serves(tmp_path):
+    """The ladder half of the version-skew contract: a corrupt/foreign
+    drain checkpoint (the strict `resume` above refuses it) must not
+    refuse service on the full ladder — resume_any quarantines the bad
+    file to a stamped forensic copy and falls through to fresh. TWO
+    corrupt resumes keep TWO distinct copies: the evidence of two
+    independent corruptions is itself evidence."""
+    import glob
+
+    bad = tmp_path / "skewed.state"
+    bad.write_bytes(b"MOMP-STATE/9\n" + b"\x00" * 32)  # future version
+    d, source, detail = ServingDaemon.resume_any(
+        checkpoint_path=str(bad), policy=ServePolicy(max_batch=2))
+    assert source == "fresh" and d.queue.depth() == 0
+    assert "magic" in detail["checkpoint_error"]
+    copies = glob.glob(str(bad) + ".corrupt.*")
+    assert len(copies) == 1 and detail["checkpoint_quarantine"] == copies[0]
+    assert not bad.exists()  # moved aside, never re-read
+
+    bad.write_bytes(b"second independent corruption")
+    d2, source2, detail2 = ServingDaemon.resume_any(
+        checkpoint_path=str(bad), policy=ServePolicy(max_batch=2))
+    assert source2 == "fresh"
+    copies2 = sorted(glob.glob(str(bad) + ".corrupt.*"))
+    assert len(copies2) == 2  # the first forensic copy survived
+    assert detail2["checkpoint_quarantine"] in copies2
+
+
 def test_chaos_soak_every_ticket_terminal(monkeypatch, make_board):
     """The soak contract: under mid-queue faults AND admission pressure,
     every submitted ticket ends in exactly one terminal state with either

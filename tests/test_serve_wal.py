@@ -14,6 +14,7 @@ set of ACKED tickets: zero under ``every-record`` (and, on process
 death, under ``off``), at most one chunk under ``every-chunk``.
 """
 
+import glob
 import json
 import os
 import pickle
@@ -455,7 +456,11 @@ def test_resume_any_ladder_order(tmp_path, make_board):
     d, source, detail = ServingDaemon.resume_any(
         wal_path=bad, checkpoint_path=ck, policy=pol)
     assert source == "checkpoint" and "magic" in detail["wal_error"]
-    assert os.path.exists(bad + ".corrupt")  # quarantined, not appended-to
+    # Quarantined (not appended-to) under a stamped unique name, so a
+    # second corrupt resume can never clobber this forensic copy.
+    quarantined = glob.glob(bad + ".corrupt.*")
+    assert len(quarantined) == 1
+    assert detail["wal_quarantine"] == quarantined[0]
     assert d.queue.depth() == 1
 
 
