@@ -61,6 +61,13 @@ WATCH_FIELDS = (
     "batched_cups",
     "batched_steady_cups",
     "batched_requests_per_sec",
+    # Board-sliced batched engine (PR 10): the raw rate plus its ratio
+    # over the vmapped cell-packed baseline measured in the same process
+    # (the ratio is RTT- and machine-noise-cancelled, so a quiet erosion
+    # of the layout's advantage trips the sentinel even when absolute
+    # rates drift together).
+    "bitsliced_cups",
+    "vs_cellpacked",
     "attention_32k_causal_tflops",
     "attention_32k_grad_tflops",
     "attention_32k_causal_sec",
@@ -102,8 +109,9 @@ def direction_for(field: str) -> str:
     return "higher"
 
 #: Record fields carrying engine provenance, rank-compared for downgrades.
-PROVENANCE_FIELDS = ("impl", "batch_engine", "attention_engine",
-                     "attention_hop_engine", "attention_hop_engine_bwd")
+PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
+                     "attention_engine", "attention_hop_engine",
+                     "attention_hop_engine_bwd")
 
 DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch")
 
@@ -111,13 +119,18 @@ _BACKEND_RANK = {"cpu": 0, "gpu": 1, "tpu": 2}
 
 
 def engine_rank(stamp) -> int:
-    """Coarse engine tiers: repo Pallas kernels > packed/fused native
-    paths > jnp/XLA folds. Suffixes (``:b1024``, ``:zz``, ``:bB``) and the
+    """Coarse engine tiers: the board-sliced batched layout > repo
+    Pallas kernels > packed/fused native paths > jnp/XLA folds (the
+    cell-packed ``batch_pack_layout`` vocabulary lands in the bottom
+    tier, so ``bitsliced -> cell-packed`` is a downgrade exactly like
+    ``pallas -> jnp``). Suffixes (``:b1024``, ``:zz``, ``:bB``) and the
     ``batch:``/``local:`` prefixes don't change the tier."""
     s = str(stamp or "")
     for prefix in ("batch:", "local:"):
         if s.startswith(prefix):
             s = s[len(prefix):]
+    if s.startswith("bitsliced"):
+        return 4
     if "pallas" in s:
         return 3
     if s.startswith(("bitfused", "vmem", "grid", "fused", "frame")):

@@ -24,6 +24,18 @@ frame-vs-XLA A/B at one size: the natural dispatcher row plus an
 ``xla-forced`` row driving ``bitlife.life_run_bits_xla`` directly on
 the same board, settling how much the padded-frame path actually buys
 at unaligned sizes.
+
+``--batch-ab N [N ...]`` (queued for r06) is the batched-layout twin:
+per board size and per ``--batches`` B it records three rows on the
+SAME seeded stack — the board-sliced engine (DESIGN.md §12), the
+cell-packed native dispatch with ``MOMP_BITSLICE`` pinned off, and the
+vmapped cell-packed XLA baseline. Rows key on (n, ``<layout>:b<B>``)
+so every (size, batch) cell of the A/B grid merges independently, and
+each (n, B) pair also lands one ledger entry (``MOMP_LEDGER`` /
+``--update`` CSV both) carrying ``bitsliced_cups``/``vs_cellpacked``
+so the regression sentinel trends the layout's advantage across chip
+windows. All three engines are cross-checked bit-exact on the stack
+before any of them is timed.
 """
 
 from __future__ import annotations
@@ -72,6 +84,39 @@ def measure(n: int, steps: int, runner=None) -> tuple[float, bool]:
     return t1 / steps, False
 
 
+def measure_stack(run, steps: int) -> tuple[float, bool]:
+    """Steady seconds/step for a prepared batched runner ``run(steps)``.
+
+    Same best-of-3 chained-differencing discipline as :func:`measure`;
+    the caller owns the stack and the engine so the three A/B rows of
+    one (n, B) cell time the identical boards."""
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    anchor_sync(run(steps), fetch_all=True)  # compile
+    anchor_sync(run(3 * steps), fetch_all=True)
+
+    def timed(s: int) -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            anchor_sync(run(s), fetch_all=True)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t3 = timed(steps), timed(3 * steps)
+    if t3 > t1:
+        return (t3 - t1) / (2 * steps), True
+    return t1 / steps, False
+
+
+def _cellpacked(pallas_life, stack, steps: int):
+    """The cell-packed native dispatch on a stack, with the board-sliced
+    layout pinned off for the duration so the ladder can't pick it back
+    up — the A/B's control arm."""
+    with pallas_life._bitslice_pinned(False):
+        return pallas_life.life_run_vmem_batch(stack, steps)
+
+
 def merge_rows(out_path: str, header: str, new_rows: list[str]) -> list[str]:
     """Header + data rows with ``new_rows`` merged over whatever
     ``out_path`` already holds, keyed on (first column, path column) and
@@ -103,6 +148,17 @@ def main(argv=None) -> int:
                     "dispatcher row plus an xla-forced row on the same "
                     "board (pair with --update to land both next to the "
                     "committed curve)")
+    ap.add_argument("--batch-ab", type=int, nargs="+", default=None,
+                    metavar="N",
+                    help="batched-layout A/B instead of the curve: per "
+                    "size and per --batches B, a board-sliced row, a "
+                    "cell-packed native row (MOMP_BITSLICE pinned off) "
+                    "and a vmapped-XLA row on the same stack; rows key "
+                    "(n, <layout>:b<B>) and each (n, B) pair lands one "
+                    "ledger entry when MOMP_LEDGER is set")
+    ap.add_argument("--batches", type=int, nargs="+", default=[8, 32, 64],
+                    metavar="B",
+                    help="batch sizes for --batch-ab (default 8 32 64)")
     ap.add_argument("--update", action="store_true",
                     help="merge rows into --out keyed on (n, path) instead "
                     "of overwriting — incremental chip windows")
@@ -156,7 +212,88 @@ def main(argv=None) -> int:
         )
         flush()
 
-    if args.ab is not None:
+    if args.batch_ab is not None:
+        import jax.numpy as jnp
+
+        from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+        if args.out == ap.get_default("out"):
+            args.out = "results/life/batched_ab_tpu.csv"
+        ledger_out = None
+        try:
+            from mpi_and_open_mp_tpu.obs import ledger as obs_ledger
+            ledger_out = obs_ledger.ledger_path()
+        except Exception:
+            pass
+
+        for n in args.batch_ab:
+            for b in args.batches:
+                stack_np = (np.random.default_rng(46 + b).random(
+                    (b, n, n)) < 0.3).astype(np.uint8)
+                stack = jax.device_put(jnp.asarray(stack_np))
+                cp_path = pallas_life.native_path_batch(
+                    stack_np.shape, allow_bitsliced=False)
+                # Forced board-sliced arm: the Pallas VMEM kernel inside
+                # the gate, the halo-fused XLA twin beyond it (still the
+                # board-sliced layout — the A/B is layout vs layout,
+                # never gated away like the natural dispatcher).
+                kern = bitlife.fits_vmem_bitsliced(stack_np.shape)
+                engines = [
+                    (f"bitsliced:b{b}", lambda s: bitlife
+                     .life_run_bitsliced_batch(stack, s, use_kernel=kern)),
+                    (f"cellpacked-{cp_path}:b{b}",
+                     lambda s: _cellpacked(pallas_life, stack, s)),
+                    (f"xla-vmapped:b{b}", lambda s: bitlife
+                     .life_run_bits_xla_batch(stack, s)),
+                ]
+                # Honesty gate per cell: all three engines bit-identical
+                # on the stack before any of them is timed (the natural
+                # dispatcher is already oracle-gated above, and the
+                # bitsliced engine's per-board oracle parity is pinned
+                # by tests/test_bitlife.py).
+                outs = [np.asarray(jax.device_get(run(8)))
+                        for _, run in engines]
+                if not (np.array_equal(outs[0], outs[1])
+                        and np.array_equal(outs[0], outs[2])):
+                    print(f"batch-ab parity failed at n={n} B={b}; "
+                          "not recording", file=sys.stderr)
+                    return 1
+                # ~0.5 s steady compute over the AGGREGATE cell count.
+                steps = max(100, min(2_000_000, int(7e11 / (b * n * n))))
+                cell_rates = {}
+                for label, run in engines:
+                    sec, diff = measure_stack(run, steps)
+                    gcups = b * n * n / sec / 1e9
+                    cell_rates[label.split(":")[0]] = b * n * n / sec
+                    new_rows.append(f"{n},{steps},{label},"
+                                    f"{sec * 1e6:.3f},{gcups:.1f},{int(diff)}")
+                    flush()
+                if ledger_out:
+                    bs = cell_rates["bitsliced"]
+                    cp = cell_rates[f"cellpacked-{cp_path}"]
+                    path_nat = pallas_life.native_path_batch(
+                        stack_np.shape)
+                    rec = {
+                        "metric": "life_batched_ab_bigboard",
+                        "board": [n, n], "dtype": "uint8",
+                        "steps": steps, "batch": b,
+                        "batch_engine": "batch:" + path_nat,
+                        "batch_pack_layout": pallas_life
+                        .batch_pack_layout(stack_np.shape),
+                        "impl": "batch:" + path_nat,
+                        "bitsliced_cups": round(bs, 1),
+                        "cellpacked_native_cups": round(cp, 1),
+                        "xla_vmapped_cups": round(
+                            cell_rates["xla-vmapped"], 1),
+                        "vs_cellpacked": round(bs / cp, 2),
+                        "backend": jax.default_backend(),
+                        "device_kind": jax.devices()[0].device_kind,
+                    }
+                    obs_ledger.append(obs_ledger.stamp(
+                        rec, source="sweep_bigboard.py",
+                        platform=jax.default_backend(),
+                        device_count=jax.device_count()), ledger_out)
+    elif args.ab is not None:
         from mpi_and_open_mp_tpu.ops import bitlife
 
         record(args.ab, native_path((args.ab, args.ab)))

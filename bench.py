@@ -118,16 +118,23 @@ def _batched_phase(batch: int, cups_single: float) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from mpi_and_open_mp_tpu.ops import pallas_life
+    from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
     from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
     from mpi_and_open_mp_tpu.serve import ShapeBucketBatcher, retrace_counts
     from mpi_and_open_mp_tpu.utils.timing import anchor_sync
 
     rng = np.random.default_rng(47)  # distinct per-board soups
     stack = (rng.random((batch, NY, NX)) < 0.3).astype(np.uint8)
-    path = pallas_life.native_path_batch(
-        stack.shape, on_tpu=jax.default_backend() == "tpu")
-    fields = {"batch": batch, "batch_engine": f"batch:{path}"}
+    on_tpu = jax.default_backend() == "tpu"
+    path = pallas_life.native_path_batch(stack.shape, on_tpu=on_tpu)
+    fields = {
+        "batch": batch,
+        "batch_engine": f"batch:{path}",
+        # Closed vocabulary {cell-packed, bitsliced}; the ledger keys on
+        # it and the sentinel flags bitsliced -> cell-packed downgrades.
+        "batch_pack_layout": pallas_life.batch_pack_layout(
+            stack.shape, on_tpu=on_tpu),
+    }
 
     # Per-board honesty gate: the batched engine must be bit-exact on
     # EVERY board of the stack (a fused-over-batch bug could corrupt one
@@ -180,6 +187,48 @@ def _batched_phase(batch: int, cups_single: float) -> dict:
         "batched_vs_single": (round(updates / best / cups_single, 2)
                               if cups_single else None),
     })
+
+    if fields["batch_pack_layout"] == "bitsliced":
+        # Layout A/B, both sides the same discipline: chain-differenced
+        # per-step rate (9x chain, best of 3) with the baseline engine
+        # parity-gated first. The baseline is the engine a bitsliced
+        # stack would otherwise run — the vmapped cell-packed XLA loop
+        # (the daemon's "batch:xla" rung). The ratio is measured in ONE
+        # process so RTT and machine noise cancel; the sentinel watches
+        # it for quiet erosion of the layout's advantage.
+        n0, mult_ab, cells = min(STEPS, 200), 9, batch * NY * NX
+
+        def steady_of(run):
+            anchor_sync(run(n0), fetch_all=True)  # warm re-dispatch
+
+            def t(n):
+                b = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    anchor_sync(run(n), fetch_all=True)
+                    b = min(b, time.perf_counter() - t0)
+                return b
+
+            t1, t2 = t(n0), t(n0 * mult_ab)
+            if t2 > t1:
+                return (t2 - t1) / (n0 * (mult_ab - 1))
+            return t1 / n0
+
+        base8 = np.asarray(bitlife.life_run_bits_xla_batch(stack_j, 8))
+        if not np.array_equal(base8, got):
+            fields["batched_error"] = (
+                "cell-packed baseline diverged from the gated bitsliced "
+                "output — layout A/B not recorded")
+            return fields
+        per_bs = steady_of(
+            lambda n: pallas_life.life_run_vmem_batch(stack_j, n))
+        per_cp = steady_of(
+            lambda n: bitlife.life_run_bits_xla_batch(stack_j, n))
+        fields.update({
+            "bitsliced_cups": round(cells / per_bs, 1),
+            "cellpacked_vmapped_cups": round(cells / per_cp, 1),
+            "vs_cellpacked": round(per_cp / per_bs, 2),
+        })
 
     # Serve-layer demo: the SAME B requests through the micro-batcher —
     # one shape bucket, one dispatch, and (steps being runtime) zero new

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# r06 queued increment (ISSUE 10, DESIGN.md §12): board-sliced vs
+# cell-packed batched A/B at the 64^2 small board — the layout's home
+# turf, where dispatch amortization and the 32-boards-per-word layout
+# stack. Three rows per batch size (bitsliced / cellpacked-native /
+# xla-vmapped) on the same seeded stack, plus one ledger entry per
+# (n, B) carrying bitsliced_cups + vs_cellpacked for the sentinel.
+# Drained by launchers/tpu_queue_loop.sh (which exports MOMP_LEDGER);
+# one chip process, exits nonzero on failure so the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python analysis/sweep_bigboard.py --batch-ab 64 --batches 8 32 64 \
+  --update --out results/life/batched_ab_tpu.csv

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# r06 queued increment (ISSUE 10): the 128^2 middle point of the
+# batched-layout A/B grid — still VMEM-resident in both layouts at
+# every batch size, so this row isolates the vector-op win from any
+# residency effect. Same three-row + ledger contract as 10_*.sh.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python analysis/sweep_bigboard.py --batch-ab 128 --batches 8 32 64 \
+  --update --out results/life/batched_ab_tpu.csv

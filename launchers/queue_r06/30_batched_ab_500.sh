@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# r06 queued increment (ISSUE 10): the reference flagship 500^2 board
+# batched. B=64 overflows the conservative bitsliced VMEM gate (two
+# planes), so its bitsliced arm runs the halo-fused XLA twin — the row
+# that prices the layout beyond the kernel's residency window. Same
+# three-row + ledger contract as 10_*.sh.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python analysis/sweep_bigboard.py --batch-ab 500 --batches 8 32 64 \
+  --update --out results/life/batched_ab_tpu.csv

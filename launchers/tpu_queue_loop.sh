@@ -13,9 +13,12 @@
 #              The loop exits when no numbered jobs remain.
 #              launchers/queue_r05/ holds the queued-but-unrecorded r05
 #              increments (frame-vs-XLA A/B, 20000/32768 board-curve
-#              rows, 8k GQA re-record — see results/README.md); one pass
-#              of this loop over that directory drains them all when a
-#              chip window opens.
+#              rows, 8k GQA re-record — see results/README.md);
+#              launchers/queue_r06/ holds the board-sliced batched A/B
+#              grid (B in {8,32,64} x boards in {64^2,128^2,500^2},
+#              DESIGN.md §12 — ledger lines ride MOMP_LEDGER). One pass
+#              of this loop over a directory drains it when a chip
+#              window opens.
 #   LOG        append-only log (default /tmp/tpu_queue.log).
 #
 # Env knobs (tests stub the probe; operators rarely need these):

@@ -44,8 +44,12 @@ import time
 ENV = "MOMP_LEDGER"
 
 #: Canonical key-field order; ``config_key`` renders them in this order.
+#: ``batch_pack_layout`` joined in PR 10: a bitsliced and a cell-packed
+#: run of the same stack are different configurations (the sentinel
+#: treats bitsliced → cell-packed as a provenance downgrade, same as
+#: pallas → jnp).
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
-              "engine")
+              "batch_pack_layout", "engine")
 
 _GIT_SHA: str | None = None
 
@@ -101,6 +105,9 @@ def stamp(record: dict, *, source: str = "bench.py",
         "dtype": record.get("dtype", "?"),
         "steps": record.get("steps", "?"),
         "batch": record.get("batch", 0),
+        # "-" for non-batched lines (no stack, no pack layout); batched
+        # lines carry the closed vocabulary {cell-packed, bitsliced}.
+        "batch_pack_layout": record.get("batch_pack_layout", "-"),
         "engine": record.get("impl", "?"),
     }
     return {

@@ -26,6 +26,9 @@ exercise the same code path.
 
 from __future__ import annotations
 
+import contextlib
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -35,6 +38,33 @@ from mpi_and_open_mp_tpu.ops import life_ops
 
 # Keep the in-kernel board + temporaries comfortably inside VMEM.
 _VMEM_BYTES_LIMIT = 4 * 1024 * 1024
+
+# Board-sliced batched layout (ops.bitlife pack_batch_bits): bit axis =
+# batch, 32 boards per uint32 word, one vector op advances every world.
+# MOMP_BITSLICE=0 pins every batched dispatch back to the cell-packed
+# ladder (the regression sentinel flags that as a provenance downgrade —
+# the switch exists for triage, not for quiet production use).
+_BITSLICE = os.environ.get("MOMP_BITSLICE", "1") != "0"
+
+# Below this batch the plane is >75% padding and the cell-packed ladder
+# (which scales its work with B, not ceil(B/32)) stays competitive.
+BITSLICE_MIN_BATCH = 8
+
+
+@contextlib.contextmanager
+def _bitslice_pinned(value: bool):
+    """Pin the bitsliced layout gate for one dispatch: the serve
+    daemon's guarded fallback rung re-dispatches a poisoned bitsliced
+    bucket on the cell-packed ladder by re-planning with the layout
+    pinned off (same shape, distinct engine + jit cache key — the flag
+    is read at plan time, like ``context._ring_hop_pinned``)."""
+    global _BITSLICE
+    prev = _BITSLICE
+    _BITSLICE = value
+    try:
+        yield
+    finally:
+        _BITSLICE = prev
 
 
 def _interpret() -> bool:
@@ -69,27 +99,41 @@ def native_path(shape: tuple[int, int], on_tpu: bool = True) -> str:
 
 
 def native_path_batch(
-    shape: tuple[int, int, int], on_tpu: bool = True
+    shape: tuple[int, int, int], on_tpu: bool = True,
+    allow_bitsliced: bool = True,
 ) -> str:
     """Which batched native path :func:`life_run_vmem_batch` dispatches a
-    (B, ny, nx) stack to: ``"vmem"`` (whole stack VMEM-resident — the
-    gate is B x the per-board working set,
-    ``bitlife.fits_vmem_packed_batch``), ``"vmem-grid"`` (per-board
-    VMEM-resident, batch axis streamed by a Pallas grid), ``"fused"`` /
-    ``"frame"`` (big-board engines, the stack scanned inside one
-    program), or ``"xla"`` (vmapped compiled-XLA packed loop). The
-    single source of truth for the batched dispatch decision, as
-    :func:`native_path` is for single boards.
+    (B, ny, nx) stack to — the single source of truth for batched
+    LAYOUT and path, as :func:`native_path` is for single boards:
+    ``"bitsliced"`` (board-sliced planes, bit axis = batch — Pallas
+    VMEM kernel on hardware, the halo-fused XLA twin elsewhere),
+    ``"vmem"`` (whole stack VMEM-resident cell-packed — the gate is B x
+    the per-board working set, ``bitlife.fits_vmem_packed_batch``),
+    ``"vmem-grid"`` (per-board VMEM-resident, batch axis streamed by a
+    Pallas grid), ``"fused"`` / ``"frame"`` (big-board engines, the
+    stack scanned inside one program), or ``"xla"`` (vmapped
+    compiled-XLA packed loop).
 
-    Off-TPU everything goes ``"xla"``: the single-board dispatcher runs
-    small boards through interpret-mode Pallas so tests cover the
-    production path, but a batch exists for THROUGHPUT — interpret mode
-    would grind B boards through a Python-level VM while the vmapped
-    packed loop compiles on every backend (the batched kernels get their
-    interpret-mode coverage from tests/test_batched.py directly)."""
+    Small-board/large-B stacks go ``"bitsliced"`` on EVERY backend: B
+    boards cost ``ceil(B/32)`` planes of vector work instead of B
+    bitplanes, and the XLA twin is the fastest CPU engine too (~8x the
+    vmapped cell-packed loop at B=32, 64²). ``MOMP_BITSLICE=0`` (or
+    ``allow_bitsliced=False``, the daemon's fallback-rung pin) restores
+    the cell-packed ladder. Off-TPU that ladder always lands ``"xla"``:
+    a batch exists for THROUGHPUT — interpret mode would grind B boards
+    through a Python-level VM while the vmapped packed loop compiles on
+    every backend (the batched kernels get their interpret-mode
+    coverage from tests/test_batched.py directly)."""
     from mpi_and_open_mp_tpu.ops import bitlife
 
     b, ny, nx = shape
+    if (
+        allow_bitsliced
+        and _BITSLICE
+        and b >= BITSLICE_MIN_BATCH
+        and bitlife.fits_vmem_bitsliced(shape)
+    ):
+        return "bitsliced"
     if on_tpu:
         if bitlife.fits_vmem_packed_batch(shape):
             return "vmem"
@@ -102,6 +146,38 @@ def native_path_batch(
     return "xla"
 
 
+def batch_pack_layout(
+    shape: tuple[int, int, int], on_tpu: bool = True
+) -> str:
+    """The pack layout :func:`life_run_vmem_batch` uses for a (B, ny,
+    nx) stack: ``"bitsliced"`` (bit axis = batch) or ``"cell-packed"``
+    (bit axis = space). Derived from :func:`native_path_batch` so the
+    two can never disagree; bench lines and the ledger config key
+    record this vocabulary."""
+    path = native_path_batch(shape, on_tpu=on_tpu)
+    return "bitsliced" if path == "bitsliced" else "cell-packed"
+
+
+def batch_slice_width(
+    shape: tuple[int, int], on_tpu: bool = True
+) -> int | None:
+    """Plane width (32) when (ny, nx) boards can take the bitsliced
+    path at some batch size, else ``None``. The serve layer sizes its
+    buckets with this: a bitsliced dispatch costs the same for every B
+    within a plane, so buckets pad to multiples of 32 (filling planes
+    exactly) instead of the pow2 ladder — and admission's
+    padding-waste projection must use the SAME width, or tickets get
+    shed against the wrong denominator."""
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    ny, nx = shape
+    if _BITSLICE and bitlife.fits_vmem_bitsliced(
+        (BITSLICE_MIN_BATCH, ny, nx)
+    ):
+        return 32
+    return None
+
+
 def life_run_vmem_batch(boards: jnp.ndarray, n: int) -> jnp.ndarray:
     """Advance a (B, ny, nx) stack ``n`` steps in ONE dispatch, picking
     the fastest batched native path (see :func:`native_path_batch`).
@@ -111,6 +187,12 @@ def life_run_vmem_batch(boards: jnp.ndarray, n: int) -> jnp.ndarray:
     from mpi_and_open_mp_tpu.ops import bitlife
 
     path = native_path_batch(boards.shape, on_tpu=not _interpret())
+    if path == "bitsliced":
+        # Pallas VMEM kernel on hardware; on CPU the halo-fused XLA
+        # twin IS the fast path (use_kernel=None picks per backend).
+        return bitlife.life_run_bitsliced_batch(
+            boards, n, interpret=_interpret()
+        )
     if path in ("vmem", "vmem-grid"):
         return bitlife.life_run_vmem_bits_batch(
             boards, n, interpret=_interpret(), resident=(path == "vmem")

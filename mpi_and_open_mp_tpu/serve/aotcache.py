@@ -124,6 +124,11 @@ def fingerprint(stack_shape: tuple[int, int, int], dtype) -> dict:
         "steps": STEPS_SIGNATURE,
         "engine_path": "batch:" + pallas_life.native_path_batch(
             (b, ny, nx), on_tpu=on_tpu),
+        # Keyed explicitly as well as via engine_path: a cell-packed
+        # artifact must never serve a bitsliced bucket (different pack
+        # transpose), even if path names are ever renamed.
+        "pack_layout": pallas_life.batch_pack_layout(
+            (b, ny, nx), on_tpu=on_tpu),
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "platform": jax.default_backend(),
@@ -140,14 +145,20 @@ def digest_for(key: dict) -> str:
 
 def bucket_sizes(max_batch: int) -> list[int]:
     """Every batch size ``serve.batcher.bucket_batch_size`` can emit:
-    powers of two below ``max_batch`` plus ``max_batch`` itself — at
-    most ``log2(max_batch)+1`` programs per shape."""
-    sizes, b = [], 1
+    powers of two below ``max_batch`` plus ``max_batch`` itself, plus —
+    for bitsliced-eligible shapes — the 32-board plane multiples the
+    slice-width rounding pads to. Still O(log + max_batch/32) programs
+    per shape."""
+    sizes, b = set(), 1
     while b < max_batch:
-        sizes.append(b)
+        sizes.add(b)
         b *= 2
-    sizes.append(int(max_batch))
-    return sizes
+    sizes.add(int(max_batch))
+    w = 32
+    while w <= max_batch:
+        sizes.add(w)
+        w += 32
+    return sorted(sizes)
 
 
 def save_artifact(path: str, key: dict, blob: bytes) -> None:
@@ -360,8 +371,9 @@ class AOTCache:
 
     def warm(self, boards, max_batch: int) -> dict:
         """The preload phase: ensure every bucket program for the given
-        ``(shape, dtype)`` pairs across all power-of-two buckets up to
-        ``max_batch`` — on a warm cache this is pure deserialization
+        ``(shape, dtype)`` pairs across all dispatchable bucket sizes up
+        to ``max_batch`` (:func:`bucket_sizes` — powers of two plus the
+        bitsliced plane multiples) — on a warm cache this is pure deserialization
         (milliseconds); on a cold one it is the plan/compile-once pass
         whose artifacts make every later restart warm. Returns the
         stats delta for this pass."""

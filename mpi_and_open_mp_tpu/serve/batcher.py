@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 _BATCH_FNS = (
+    "life_batch_bitsliced",
     "life_batch_vmem",
     "life_batch_xla",
     "life_batch_fused",
@@ -23,14 +24,37 @@ _BATCH_FNS = (
 )
 
 
-def bucket_batch_size(n_requests: int, max_batch: int) -> int:
+def bucket_batch_size(
+    n_requests: int, max_batch: int, slice_width: int | None = None
+) -> int:
     """The padded batch a dispatch of ``n_requests`` same-shape boards
     uses: the next power of two, capped at ``max_batch``. The cap keeps
     the compiled-program set to at most ``log2(max_batch)+1`` stack
     shapes per board shape; the pow-2 rounding means a bucket that grows
-    request by request re-compiles O(log R) times, not O(R)."""
+    request by request re-compiles O(log R) times, not O(R).
+
+    ``slice_width`` (``ops.pallas_life.batch_slice_width``) switches the
+    rounding to plane multiples when the shape is bitsliced-eligible: a
+    bitsliced dispatch costs the same for EVERY live count within a
+    32-board plane, so a 20-request bucket pads straight to 32 (filling
+    one plane) instead of wandering the pow2 ladder — fewer compiled
+    stack shapes, never more planes of vector work (65 requests pad to
+    96, not pow2's 128), and zero marginal compute for the padding.
+    Chunks below ``BITSLICE_MIN_BATCH`` keep the pow2 rule: their
+    padded stack would dispatch cell-packed anyway, and plane-rounding
+    a lone request to 32 would make admission's waste projection shed
+    the first submission to an empty queue. Also falls back to pow2
+    when the width exceeds ``max_batch`` (the plane can never dispatch
+    whole)."""
     if n_requests < 1:
         raise ValueError(f"bucket_batch_size: need >= 1 request, got {n_requests}")
+    if slice_width and slice_width <= max_batch:
+        from mpi_and_open_mp_tpu.ops.pallas_life import BITSLICE_MIN_BATCH
+
+        if n_requests >= BITSLICE_MIN_BATCH:
+            padded = -(-n_requests // slice_width) * slice_width
+            if padded <= max_batch:
+                return padded
     b = 1
     while b < n_requests and b < max_batch:
         b *= 2
@@ -145,10 +169,12 @@ class ShapeBucketBatcher:
             by_steps: dict[int, list[_Request]] = {}
             for r in reqs:
                 by_steps.setdefault(r.steps, []).append(r)
+            width = pallas_life.batch_slice_width(shape, on_tpu=on_tpu)
             for steps, group in by_steps.items():
                 for lo in range(0, len(group), self.max_batch):
                     chunk = group[lo:lo + self.max_batch]
-                    padded = bucket_batch_size(len(chunk), self.max_batch)
+                    padded = bucket_batch_size(
+                        len(chunk), self.max_batch, slice_width=width)
                     stack = np.zeros((padded, *shape), dtype=chunk[0].board.dtype)
                     for i, r in enumerate(chunk):
                         stack[i] = r.board

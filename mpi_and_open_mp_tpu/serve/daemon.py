@@ -372,11 +372,14 @@ class ServingDaemon:
         the durable AOT executable (when a cache is attached — a
         deserialized ``jax.export`` program that runs with ZERO
         retraces, oracle parity-gated on first use), then the batched
-        native path (Pallas/VMEM on TPU, vmapped XLA off it), then the
-        always-compilable vmapped XLA bit engine, then the NumPy oracle
-        — the one engine that needs no device at all. The AOT rung's
-        stamp carries its cache provenance: ``aot:<path>`` on a
-        hit/resident program, ``aot:<path>:miss`` /
+        native path — bitsliced board-planes when the stack qualifies,
+        else the cell-packed ladder — then, under a bitsliced plan, the
+        cell-packed native engine with the layout pinned off (a poisoned
+        bitsliced dispatch degrades one rung, not straight to vmapped
+        XLA), then the always-compilable vmapped XLA bit engine, then
+        the NumPy oracle — the one engine that needs no device at all.
+        The AOT rung's stamp carries its cache provenance:
+        ``aot:<path>`` on a hit/resident program, ``aot:<path>:miss`` /
         ``aot:<path>:corrupt`` / ``aot:<path>:stale`` when this dispatch
         had to build fresh (a bad artifact was quarantined first).
         Fallback engines run under ``chaos.suppressed()`` so a recovery
@@ -385,8 +388,8 @@ class ServingDaemon:
 
         from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
 
-        path = pallas_life.native_path_batch(
-            stack.shape, on_tpu=jax.default_backend() == "tpu")
+        on_tpu = jax.default_backend() == "tpu"
+        path = pallas_life.native_path_batch(stack.shape, on_tpu=on_tpu)
 
         rungs = []
         if self._aot is not None:
@@ -432,8 +435,25 @@ class ServingDaemon:
                     out[b] = board
                 return out
 
-        rungs += [(f"batch:{path}", native), ("batch:xla", xla),
-                  ("oracle", oracle)]
+        rungs.append((f"batch:{path}", native))
+        if path == "bitsliced":
+            # One-rung degrade: re-plan the same stack with the layout
+            # pinned off. Off-TPU the cell-packed plan is "xla" already,
+            # identical to the rung below — skip the duplicate.
+            cp_path = pallas_life.native_path_batch(
+                stack.shape, on_tpu=on_tpu, allow_bitsliced=False)
+            if cp_path != "xla":
+
+                def cellpacked():
+                    import jax.numpy as jnp
+
+                    with chaos.suppressed(), \
+                            pallas_life._bitslice_pinned(False):
+                        return np.asarray(pallas_life.life_run_vmem_batch(
+                            jnp.asarray(stack), steps))
+
+                rungs.append((f"batch:{cp_path}", cellpacked))
+        rungs += [("batch:xla", xla), ("oracle", oracle)]
         return rungs
 
     def _dispatch_chunk(self, chunk: list[Ticket]) -> None:
@@ -464,7 +484,9 @@ class ServingDaemon:
             self._wal.dispatch_begin([t.id for t in live])
         shape = live[0].board.shape
         steps = live[0].steps
-        padded = bucket_batch_size(len(live), p.max_batch)
+        padded = bucket_batch_size(
+            len(live), p.max_batch,
+            slice_width=self.queue._slice_width(live[0].bucket_key))
         stack = np.zeros((padded, *shape), dtype=live[0].board.dtype)
         for i, t in enumerate(live):
             stack[i] = t.board

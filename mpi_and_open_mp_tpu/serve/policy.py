@@ -14,9 +14,10 @@ server can actually exhaust:
   cheaper (and honest) to reject at the door with an explicit reason
   than to time the request out later.
 * **Padding waste** — every bucket chunk pads its live requests up to a
-  power of two (``serve.batcher.bucket_batch_size``), so an adversarial
-  request mix can make the device spend most of its cycles advancing
-  dead zero-boards. :func:`padding_waste` estimates that fraction over
+  power of two, or to a 32-board plane multiple when the shape is
+  bitsliced-eligible (``serve.batcher.bucket_batch_size``), so an
+  adversarial request mix can make the device spend most of its cycles
+  advancing dead zero-boards. :func:`padding_waste` estimates that fraction over
   the whole pending set; admission rejects a request whose acceptance
   pushes the estimate past budget.
 
@@ -86,34 +87,66 @@ class ServePolicy:
                 raise ValueError(f"{name} must be >= 0")
 
 
-def padding_waste(bucket_counts: Iterable[int], max_batch: int) -> float:
+def padding_waste(
+    bucket_counts: Iterable[int | tuple[int, int | None]],
+    max_batch: int,
+) -> float:
     """Estimated dead-padding fraction of dispatching these buckets now.
 
     Each bucket of ``r`` live requests dispatches as full ``max_batch``
-    chunks plus one remainder chunk padded to the next power of two; the
-    waste is padded slots minus live requests over padded slots. 0.0 for
-    an empty queue (nothing to dispatch wastes nothing).
-    """
+    chunks plus one remainder chunk padded by
+    ``serve.batcher.bucket_batch_size``; the waste is padded slots minus
+    live requests over padded slots. 0.0 for an empty queue (nothing to
+    dispatch wastes nothing).
+
+    Items may be plain counts or ``(count, slice_width)`` pairs — the
+    width the dispatcher will ACTUALLY pad that bucket's shape with
+    (``ops.pallas_life.batch_slice_width``: 32 for bitsliced-eligible
+    shapes, ``None`` for the pow2 ladder). Admission must project with
+    the same width the dispatcher rounds with, or tickets get shed
+    against the wrong denominator. For a width bucket that denominator
+    is the PLANE, not the board slot: the board-sliced engine's cost
+    unit is one plane of vector work, a partly-dead plane costs exactly
+    what a full one does, and ``ceil(r/width)`` planes is already the
+    minimum any dispatch of ``r`` such requests can pay — so plane
+    padding is not avoidable waste, and the bucket counts as its plane
+    quanta, fully live. (Counting dead board SLOTS here was the cliff
+    this rule replaces: request 9 of a 64² bucket projected 72% "waste"
+    and was shed, while its true marginal cost was zero.) Pow2 buckets
+    keep the historical board-slot math — there the padded boards each
+    cost real vmapped compute."""
     live = padded = 0
-    for r in bucket_counts:
+    for item in bucket_counts:
+        r, width = item if isinstance(item, tuple) else (item, None)
         if r <= 0:
             continue
-        live += r
         full, rest = divmod(r, max_batch)
+        if width and width <= max_batch:
+            boards = full * max_batch
+            if rest:
+                boards += bucket_batch_size(rest, max_batch,
+                                            slice_width=width)
+            quanta = -(-boards // width)
+            live += quanta
+            padded += quanta
+            continue
+        live += r
         padded += full * max_batch
         if rest:
-            padded += bucket_batch_size(rest, max_batch)
+            padded += bucket_batch_size(rest, max_batch, slice_width=width)
     if padded == 0:
         return 0.0
     return (padded - live) / padded
 
 
 def admit(policy: ServePolicy, depth: int,
-          bucket_counts_after: Iterable[int]) -> str | None:
+          bucket_counts_after: Iterable[int | tuple[int, int | None]],
+          ) -> str | None:
     """Admission verdict for one candidate request: ``None`` to accept,
     else the shed reason. ``depth`` is the pending count BEFORE the
     candidate; ``bucket_counts_after`` are per-bucket pending counts
-    WITH the candidate already placed in its bucket."""
+    WITH the candidate already placed in its bucket — plain counts or
+    ``(count, slice_width)`` pairs, as :func:`padding_waste` takes."""
     if depth >= policy.max_depth:
         return SHED_DEPTH
     if padding_waste(bucket_counts_after,

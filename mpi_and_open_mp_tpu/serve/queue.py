@@ -98,7 +98,8 @@ class ServeQueue:
         counts = self._bucket_counts()
         counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
         reason = policy_mod.admit(
-            self.policy, self.depth(), counts.values())
+            self.policy, self.depth(),
+            [(n, self._slice_width(key)) for key, n in counts.items()])
         self._tickets[t.id] = t
         metrics.inc("serve.requests")
         if reason is not None:
@@ -148,6 +149,28 @@ class ServeQueue:
         for t in self.pending():
             counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
         return counts
+
+    def _slice_width(self, bucket_key: tuple) -> int | None:
+        """The pad width the dispatcher will round this bucket with
+        (``ops.pallas_life.batch_slice_width``) so admission's
+        padding-waste projection matches the actual dispatch. Cached per
+        shape — the gate is pure arithmetic on (ny, nx) plus one env
+        flag, both stable for the process lifetime."""
+        shape = bucket_key[0]
+        try:
+            return self._width_cache[shape]
+        except AttributeError:
+            self._width_cache: dict[tuple, int | None] = {}
+        except KeyError:
+            pass
+        import jax
+
+        from mpi_and_open_mp_tpu.ops import pallas_life
+
+        width = pallas_life.batch_slice_width(
+            shape, on_tpu=jax.default_backend() == "tpu")
+        self._width_cache[shape] = width
+        return width
 
     def buckets(self) -> dict[tuple, list[Ticket]]:
         """Pending tickets grouped by bucket, submission order inside."""

@@ -98,9 +98,22 @@ def test_fits_vmem_packed_batch_scales_with_b():
 
 
 def test_native_path_batch_policy():
-    # Off-TPU: always the vmapped XLA loop (throughput, not interpret).
-    assert pallas_life.native_path_batch((8, 500, 500), on_tpu=False) == "xla"
-    # On-TPU ladder: whole-stack resident -> grid -> fused -> frame.
+    # Small-board/large-B: board-sliced planes on EVERY backend (the
+    # halo-fused XLA twin is the fastest CPU engine too).
+    assert pallas_life.native_path_batch((8, 500, 500), on_tpu=False) \
+        == "bitsliced"
+    assert pallas_life.native_path_batch((64, 64, 64), on_tpu=True) \
+        == "bitsliced"
+    # The daemon's fallback pin restores the cell-packed ladder.
+    assert pallas_life.native_path_batch(
+        (8, 500, 500), on_tpu=False, allow_bitsliced=False) == "xla"
+    # Below the minimum batch the plane is mostly padding: cell-packed.
+    assert pallas_life.native_path_batch((4, 64, 64), on_tpu=False) == "xla"
+    # Off-TPU cell-packed ladder: always the vmapped XLA loop
+    # (throughput, not interpret).
+    assert pallas_life.native_path_batch((2, 500, 500), on_tpu=False) == "xla"
+    # On-TPU cell-packed ladder: whole-stack resident -> grid -> fused
+    # -> frame (the bitsliced VMEM gate excludes these big boards).
     assert pallas_life.native_path_batch((2, 100, 100), on_tpu=True) == "vmem"
     big = (64, 3000, 3000)
     assert pallas_life.native_path_batch(big, on_tpu=True) == "vmem-grid"
@@ -108,6 +121,31 @@ def test_native_path_batch_policy():
         (2, 16384, 16384), on_tpu=True) == "fused"
     assert pallas_life.native_path_batch(
         (2, 10000, 10000), on_tpu=True) == "frame"
+
+
+def test_batch_pack_layout_vocabulary_and_kill_switch():
+    """batch_pack_layout mirrors native_path_batch (they can never
+    disagree); MOMP_BITSLICE=0 (the module gate the env var sets) pins
+    every stack back to cell-packed, and the pinned dispatch stays
+    bit-exact — the kill switch changes provenance, never answers."""
+    assert pallas_life.batch_pack_layout((32, 64, 64)) == "bitsliced"
+    assert pallas_life.batch_pack_layout((2, 64, 64)) == "cell-packed"
+    assert pallas_life.batch_slice_width((64, 64)) == 32
+    assert pallas_life.batch_slice_width((4096, 4096)) is None
+
+    s = jnp.asarray(_stack(32, 20, 24, seed=11))
+    fast = np.asarray(pallas_life.life_run_vmem_batch(s, 6))
+    with pallas_life._bitslice_pinned(False):
+        assert pallas_life.native_path_batch(
+            (32, 64, 64), on_tpu=False) == "xla"
+        assert pallas_life.batch_pack_layout((32, 64, 64)) == "cell-packed"
+        assert pallas_life.batch_slice_width((64, 64)) is None
+        pinned = np.asarray(pallas_life.life_run_vmem_batch(s, 6))
+    assert np.array_equal(fast, pinned)
+    # The pin restores on exit.
+    assert pallas_life.batch_pack_layout((32, 64, 64)) == "bitsliced"
+    for b in range(32):
+        assert np.array_equal(fast[b], _oracle(np.asarray(s)[b], 6))
 
 
 def test_life_run_vmem_batch_dispatch_parity():
@@ -277,6 +315,120 @@ def test_bucket_batch_size():
     assert bucket_batch_size(3, 2) == 2  # cap wins over pow2
     with pytest.raises(ValueError):
         bucket_batch_size(0, 8)
+
+
+def test_bucket_batch_size_slice_width():
+    from mpi_and_open_mp_tpu.serve import bucket_batch_size
+
+    # Plane-multiple rounding for bitsliced-eligible buckets: never more
+    # planes of vector work than pow2 (65 -> 96, not 128), one compiled
+    # stack shape per plane count.
+    assert bucket_batch_size(20, 64, slice_width=32) == 32
+    assert bucket_batch_size(32, 64, slice_width=32) == 32
+    assert bucket_batch_size(33, 64, slice_width=32) == 64
+    assert bucket_batch_size(65, 128, slice_width=32) == 96
+    # Below BITSLICE_MIN_BATCH the padded stack would dispatch
+    # cell-packed anyway: pow2 (and a lone request must not project 97%
+    # padding waste at admission).
+    assert bucket_batch_size(1, 64, slice_width=32) == 1
+    assert bucket_batch_size(7, 64, slice_width=32) == 8
+    assert bucket_batch_size(8, 64, slice_width=32) == 32
+    # Width past the cap: the plane can never dispatch whole -> pow2.
+    assert bucket_batch_size(5, 8, slice_width=32) == 8
+    # None (cell-packed shapes): plain pow2.
+    assert bucket_batch_size(20, 64, slice_width=None) == 32
+
+
+def test_padding_waste_matches_dispatch_width():
+    """Admission projects with the SAME denominator the dispatcher pays
+    with: width buckets count in plane quanta (a partly-dead plane costs
+    what a full one does, so plane padding is never avoidable waste),
+    plain ints keep the historical pow2 board-slot math."""
+    from mpi_and_open_mp_tpu.serve.policy import padding_waste
+
+    assert padding_waste([5], 8) == padding_waste([(5, None)], 8)
+    # ANY count of a width bucket projects zero waste — ceil(r/32)
+    # planes is already the minimum dispatch for r requests. This is
+    # the cliff guard: request 9 must not project (32-9)/32 = 72%.
+    for r in (1, 8, 9, 20, 32, 33, 64):
+        assert padding_waste([(r, 32)], 64) == 0.0
+    # Mixed buckets: the width bucket contributes its (fully live)
+    # plane quanta, the pow2 bucket keeps its board-slot waste — so a
+    # bitsliced bucket can never get a cell-packed peer's request shed.
+    got = padding_waste([(20, 32), 3], 64)
+    assert got == pytest.approx((1 + 4 - 1 - 3) / (1 + 4))
+    assert padding_waste([3], 64) == pytest.approx(1 / 4)
+
+
+def test_batcher_pads_bitsliced_bucket_to_plane():
+    """A 20-request 64² bucket under a 64-wide batcher pads to one
+    32-board plane (not pow2) and dispatches bitsliced, every result
+    oracle-exact."""
+    from mpi_and_open_mp_tpu.serve import ShapeBucketBatcher
+
+    bat = ShapeBucketBatcher(max_batch=64)
+    boards = [_soup(64, 64, seed=100 + i) for i in range(20)]
+    for b in boards:
+        bat.submit(b, 3)
+    res = bat.flush()
+    (stat,) = bat.last_flush_stats
+    assert stat.requests == 20 and stat.padded_batch == 32
+    assert stat.path == "bitsliced"
+    for b, r in zip(boards, res):
+        assert np.array_equal(r, _oracle(b, 3))
+
+
+def test_queue_admission_uses_dispatch_width(make_board):
+    """A lone submission to an empty queue must admit even when its
+    shape is bitsliced-eligible (the regression the min-batch gate in
+    bucket_batch_size exists to prevent)."""
+    from mpi_and_open_mp_tpu.serve import ServePolicy
+    from mpi_and_open_mp_tpu.serve.queue import ServeQueue
+
+    q = ServeQueue(ServePolicy(max_batch=64, max_padding_frac=0.375))
+    t = q.submit(np.asarray(make_board(64, 64)), 4, now=0.0)
+    assert t.state == "pending", t.reason
+    assert q._slice_width(t.bucket_key) == 32
+
+
+def test_daemon_engine_ladder_bitsliced_rung():
+    """CPU ladder for a bitsliced-eligible stack: the bitsliced rung
+    leads, the vmapped-XLA rung and oracle back it (the cell-packed
+    native rung is skipped off-TPU — it would duplicate batch:xla), and
+    the rungs agree bit-exactly."""
+    from mpi_and_open_mp_tpu.serve import ServingDaemon
+
+    d = ServingDaemon.__new__(ServingDaemon)
+    d._aot = None
+    stack = _stack(32, 16, 16, seed=21)
+    rungs = d._engines(stack, 4)
+    assert [s for s, _ in rungs] == ["batch:bitsliced", "batch:xla",
+                                     "oracle"]
+    out = [np.asarray(fn()) for _, fn in rungs]
+    assert np.array_equal(out[0], out[2]) and np.array_equal(out[1], out[2])
+    # Below the bitsliced gate: plain cell-packed ladder, no dup rung.
+    assert [s for s, _ in d._engines(stack[:4], 4)] == \
+        ["batch:xla", "batch:xla", "oracle"]
+
+
+def test_aot_fingerprint_distinguishes_layouts():
+    """A cell-packed artifact can never serve a bitsliced bucket: the
+    fingerprint (and so the digest/filename) differs between a
+    bucket-32 bitsliced stack and any cell-packed keying of the same
+    shape, and records the layout vocabulary explicitly."""
+    from mpi_and_open_mp_tpu.serve import aotcache
+
+    fp_bs = aotcache.fingerprint((32, 64, 64), np.uint8)
+    fp_cp = aotcache.fingerprint((4, 64, 64), np.uint8)
+    assert fp_bs["pack_layout"] == "bitsliced"
+    assert fp_cp["pack_layout"] == "cell-packed"
+    with pallas_life._bitslice_pinned(False):
+        fp_pinned = aotcache.fingerprint((32, 64, 64), np.uint8)
+    assert fp_pinned["pack_layout"] == "cell-packed"
+    assert aotcache.digest_for(fp_pinned) != aotcache.digest_for(fp_bs)
+    # Plane multiples join the pow2 bucket enumeration.
+    assert 96 in aotcache.bucket_sizes(128)
+    assert aotcache.bucket_sizes(8) == [1, 2, 4, 8]
 
 
 def test_batcher_results_in_submission_order():
