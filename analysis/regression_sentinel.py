@@ -84,6 +84,14 @@ WATCH_FIELDS = (
     "serve_cold_first_result_s",
     "serve_aot_first_result_s",
     "serve_aot_deserialize_s",
+    # Sharded fleet (PR 11): aggregate throughput/latency across the
+    # 3-worker router, plus the wedge-to-last-rehomed-resolution time
+    # from the kill drill — recovery regressing means the heartbeat →
+    # WAL replay → re-home ladder got slower (all polarities by name:
+    # per_sec higher, _s lower).
+    "fleet_requests_per_sec",
+    "fleet_p99_latency_s",
+    "fleet_kill_recovery_s",
 )
 
 

@@ -390,6 +390,118 @@ def _serve_phase(n: int) -> dict:
     return fields
 
 
+def _fleet_phase(n: int, workers: int) -> dict:
+    """The sharded-fleet phase (``--serve N --fleet W``): the same
+    seeded burst twice through an in-process W-worker fleet
+    (``serve.fleet.Fleet`` — consistent-hash affinity, rolled-up
+    admission, per-worker WALs). Burst 1 runs clean and prices the
+    aggregate serving surface (``fleet_requests_per_sec`` + tail
+    latency). Burst 2 is the kill drill: the busiest worker is wedged
+    mid-stream, the router must detect the missed heartbeats, replay
+    the victim's journal, and re-home its pending set to the survivors
+    — ``fleet_kill_recovery_s`` is wedge-to-last-re-homed-resolved, the
+    tail-latency-under-kill number. Honesty discipline as everywhere:
+    every resolved board (re-homed included) gates bit-exact against
+    the NumPy oracle before anything is recorded, and the fleet books
+    must balance (admitted == resolved + shed, re-home moves netted)."""
+    import tempfile
+
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.serve import ServePolicy
+    from mpi_and_open_mp_tpu.serve.fleet import Fleet
+
+    policy = ServePolicy(max_batch=8, max_depth=max(64, 2 * n),
+                         max_wait_s=0.005)
+    shapes = ((48, 48), (64, 64))
+    steps = (4, 8)
+    sessions = max(4 * workers, 8)
+
+    def burst(fleet, lo=0, hi=None):
+        rng = np.random.default_rng(48)
+        for i in range(n):
+            ny, nx = shapes[i % len(shapes)]
+            board = (rng.random((ny, nx)) < 0.3).astype(np.uint8)
+            if lo <= i < (n if hi is None else hi):
+                fleet.submit(board, steps[i % len(steps)],
+                             session=f"s{i % sessions:04d}")
+
+    def parity_bad(fleet) -> int:
+        bad = 0
+        for t in fleet.resolved_tickets():
+            ref = np.asarray(t.board).copy()
+            for _ in range(t.steps):
+                ref = life_step_numpy(ref)
+            if not np.array_equal(t.result, ref):
+                bad += 1
+        return bad
+
+    fields: dict = {"fleet_workers": workers}
+    with tempfile.TemporaryDirectory(prefix="momp-bench-fleet-") as td:
+        fleet = Fleet(workers, policy,
+                      wal_dir=os.path.join(td, "clean"),
+                      heartbeat_interval_s=0.01)
+        burst(fleet)
+        t0 = time.perf_counter()
+        fleet.serve_until_drained()
+        wall = time.perf_counter() - t0
+        s = fleet.summary()
+        bad = parity_bad(fleet)
+        fields.update({
+            "fleet_requests": s["submitted"],
+            "fleet_resolved": s["resolved"],
+            "fleet_shed": s["shed"] + s["door_shed"],
+            "fleet_steals": s["steals"],
+            "fleet_requests_per_sec": (round(s["resolved"] / wall, 2)
+                                       if wall > 0 else None),
+            "fleet_p50_latency_s": s["p50_latency_s"],
+            "fleet_p99_latency_s": s["p99_latency_s"],
+            "fleet_books_balance": s["balanced"],
+            "fleet_parity": bad == 0,
+        })
+        if bad:
+            fields["fleet_error"] = (
+                f"parity check failed on {bad} resolved boards")
+
+        # The kill drill: same seed, fresh fleet; partial progress, then
+        # the busiest worker stops heartbeating and the fleet must drain
+        # anyway through the wedge->replay->re-home ladder.
+        kfleet = Fleet(workers, policy,
+                       wal_dir=os.path.join(td, "kill"),
+                       heartbeat_interval_s=0.01)
+        # Partial progress first (half the burst dispatched clean), then
+        # the rest lands and the busiest worker wedges with a loaded
+        # queue — the mid-stream death whose pending set the router must
+        # recover from the victim's journal.
+        burst(kfleet, hi=n // 2)
+        kfleet.pump()
+        burst(kfleet, lo=n // 2)
+        victim = max(kfleet.handles,
+                     key=lambda h: h.daemon.queue.depth()).index
+        t_kill = time.monotonic()
+        kfleet.wedge(victim)
+        kfleet.serve_until_drained()
+        ks = kfleet.summary()
+        kbad = parity_bad(kfleet)
+        adopted = kfleet.router.last_rehomed
+        recovered_at = [t.resolved_at for t in adopted
+                        if t.resolved_at is not None]
+        fields.update({
+            "fleet_kill_victim": victim,
+            "fleet_rehomed": ks["rehomed"],
+            "fleet_rehomed_resolved": ks["rehomed_resolved"],
+            "fleet_kill_recovery_s": (
+                round(max(recovered_at) - t_kill, 4)
+                if recovered_at else None),
+            "fleet_kill_books_balance": ks["balanced"],
+            "fleet_kill_parity": kbad == 0,
+        })
+        if kbad:
+            fields["fleet_kill_error"] = (
+                f"parity check failed on {kbad} resolved boards "
+                "(kill drill)")
+    return fields
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--board", type=int, default=None, metavar="N",
@@ -427,6 +539,16 @@ def main(argv=None) -> int:
                     "serve_aot_first_result_s + hit/miss/deserialize "
                     "accounting; runs on every backend; honors "
                     "MOMP_CHAOS)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="W",
+                    help="with --serve N: also run the SHARDED-FLEET "
+                    "phase — the same burst through W in-process worker "
+                    "daemons behind the consistent-hash router "
+                    "(serve.fleet), clean (fleet_requests_per_sec + "
+                    "fleet_p99_latency_s) and then again with the "
+                    "busiest worker wedged mid-stream so the "
+                    "heartbeat->WAL-replay->re-home ladder is priced "
+                    "(fleet_kill_recovery_s); fleet books must balance "
+                    "and every re-homed board is oracle-parity-gated")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="write obs span/event JSONL here (sets MOMP_TRACE; "
                     "summarise with analysis/trace_report.py). The timed "
@@ -441,6 +563,8 @@ def main(argv=None) -> int:
         args.ledger = os.environ.get("MOMP_LEDGER") or None
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
+    if args.fleet and not args.serve:
+        ap.error("--fleet requires --serve N")
     if args.trace:
         # Before any phase runs, so the sink (append-mode, cached per env
         # value) collects every span of this invocation.
@@ -692,6 +816,17 @@ def _bench(args, state) -> int:
                 served = {"serve_daemon_requests": args.serve,
                           "serve_daemon_error":
                           f"{type(e).__name__}: {e}"[:200]}
+        if args.fleet:
+            state["phase"] = "fleet"
+            with obs_trace.span("bench.phase", phase="fleet"):
+                try:
+                    served.update(_fleet_phase(args.serve, args.fleet))
+                except Preempted:
+                    raise
+                except Exception as e:
+                    served.update({"fleet_workers": args.fleet,
+                                   "fleet_error":
+                                   f"{type(e).__name__}: {e}"[:200]})
 
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device

@@ -47,6 +47,15 @@ LOG=${2:-/tmp/tpu_queue.log}
 PROBE=${TPUQ_PROBE_CMD:-"python -c 'import jax; print(jax.devices())'"}
 SLEEP=${TPUQ_SLEEP:-900}
 SETTLE=${TPUQ_SETTLE:-60}
+# Every sleep in the loop is followed by a chip claim (the probe), so a
+# short TPUQ_SLEEP (handy when stubbing the probe in tests) must never
+# undercut the settle gap on the failed-job -> re-probe path. Clamp,
+# integers only — a non-numeric override is left alone rather than
+# guessed at.
+case "$SLEEP$SETTLE" in
+    *[!0-9]*) ;;
+    *) [ "$SLEEP" -lt "$SETTLE" ] && SLEEP=$SETTLE ;;
+esac
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 LEDGER=${TPUQ_LEDGER-"$REPO/results/ledger.jsonl"}
 SENTINEL_FATAL=${TPUQ_SENTINEL_FATAL:-0}

@@ -49,6 +49,15 @@ Tokens:
     journaled). The write-ahead journal's crash-matrix test drives all
     three to prove the per-fsync-policy loss bounds in
     ``serve/wal.py``.
+``kill_worker=<i>:<k>``
+    Fleet drill: hard-kill (``os._exit(137)``) the serving worker whose
+    ``worker_index`` is ``<i>`` on its ``<k>``-th batch dispatch, after
+    the DISPATCH frame hits the journal but before any engine runs — a
+    mid-dispatch death, so the router's WAL replay must see the chunk
+    in-flight and re-home it. Every process of a fleet shares one
+    ``MOMP_CHAOS`` value; the index match makes exactly one worker the
+    victim (:func:`kill_worker_armed` counts per-process arrivals, and
+    processes with a different — or no — worker index never count).
 ``aot_corrupt=<kind>:<k>``
     Damage the first ``<k>`` AOT-cache artifacts ON DISK immediately
     after their crash-atomic save (:func:`take_aot_corrupt` consumes the
@@ -110,6 +119,9 @@ class FaultPlan:
     crash_site: str | None = None  # instrumented site to hard-kill at
     crash_at: int = 0  # 1-based arrival count that fires the kill
     crash_hits: int = 0  # runtime arrivals counted so far
+    kill_worker_idx: int | None = None  # fleet worker index to hard-kill
+    kill_worker_at: int = 0  # 1-based dispatch count that fires the kill
+    kill_worker_hits: int = 0  # runtime dispatches counted so far
     aot_corrupt_kind: str | None = None  # "bitflip" | "skew"
     aot_corrupt: int = 0  # total artifact saves to damage
     aot_corrupted: int = 0  # runtime count consumed so far
@@ -147,6 +159,14 @@ class FaultPlan:
                     plan.crash_at = int(k) if k else 1
                     if plan.crash_at < 1:
                         raise ValueError("crash count must be >= 1")
+                elif key == "kill_worker":
+                    idx, _, k = val.partition(":")
+                    plan.kill_worker_idx = int(idx)
+                    if plan.kill_worker_idx < 0:
+                        raise ValueError("worker index must be >= 0")
+                    plan.kill_worker_at = int(k) if k else 1
+                    if plan.kill_worker_at < 1:
+                        raise ValueError("kill count must be >= 1")
                 elif key == "aot_corrupt":
                     kind, _, k = val.partition(":")
                     if kind not in AOT_CORRUPT_KINDS:
@@ -323,6 +343,21 @@ def crash_armed(site: str) -> bool:
         return False
     plan.crash_hits += 1
     return plan.crash_hits == plan.crash_at
+
+
+def kill_worker_armed(worker_index: int | None) -> bool:
+    """Count one batch dispatch of fleet worker ``worker_index``;
+    ``True`` exactly when this dispatch is the planned ``<k>``-th of the
+    planned victim — the caller must then :func:`crash_now`. Inert (no
+    counting) for processes with no worker index, a non-matching index,
+    no plan, or :func:`suppressed` injection — the whole fleet shares
+    one ``MOMP_CHAOS`` value and only the victim ever dies."""
+    plan = active_plan()
+    if (plan is None or worker_index is None
+            or plan.kill_worker_idx != worker_index):
+        return False
+    plan.kill_worker_hits += 1
+    return plan.kill_worker_hits == plan.kill_worker_at
 
 
 def crash_now() -> None:

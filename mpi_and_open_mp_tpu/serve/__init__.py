@@ -43,8 +43,10 @@ from mpi_and_open_mp_tpu.serve.policy import (  # noqa: F401
     SHED_DISPATCH,
     SHED_PADDING,
     SHED_REASONS,
+    SHED_REHOMED,
     SHED_TIMEOUT,
     ServePolicy,
+    rollup,
 )
 from mpi_and_open_mp_tpu.serve.queue import (  # noqa: F401
     ServeQueue,
@@ -58,3 +60,8 @@ from mpi_and_open_mp_tpu.serve.wal import (  # noqa: F401
 )
 from mpi_and_open_mp_tpu.serve.aotcache import AOTCache  # noqa: F401
 from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon  # noqa: F401
+from mpi_and_open_mp_tpu.serve.router import (  # noqa: F401
+    ConsistentHashRing,
+    FleetRouter,
+)
+from mpi_and_open_mp_tpu.serve.fleet import Fleet, WorkerHandle  # noqa: F401

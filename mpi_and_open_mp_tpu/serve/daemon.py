@@ -84,8 +84,13 @@ class ServingDaemon:
                  wal_fsync: str = "every-record",
                  wal_compact_bytes: int = 1 << 20,
                  aot_cache=None,
+                 worker_index: int | None = None,
                  clock=time.monotonic, sleep=time.sleep):
         self.policy = policy or ServePolicy()
+        # Fleet identity: which shard of a serve.fleet this process is.
+        # None for the classic single-daemon deployment; the chaos
+        # kill_worker=<i>:<k> drill targets exactly one index.
+        self.worker_index = worker_index
         self.queue = ServeQueue(self.policy)
         self.checkpoint_path = checkpoint_path
         self._clock = clock
@@ -111,14 +116,17 @@ class ServingDaemon:
 
     # -- intake ------------------------------------------------------------
 
-    def submit(self, board: np.ndarray, steps: int) -> Ticket:
+    def submit(self, board: np.ndarray, steps: int,
+               session: str | None = None) -> Ticket:
         """Admit (or reject-with-reason) one request; see
         :meth:`ServeQueue.submit`. An ADMITTED ticket is journaled before
         this returns — under ``every-record`` fsync the caller's ack
         implies durability (the crash-matrix's zero-acked-loss bound).
         Door-shed tickets are terminal before they exist anywhere worth
-        replaying, so they never touch the journal."""
-        t = self.queue.submit(board, steps, self._clock())
+        replaying, so they never touch the journal. ``session`` is the
+        fleet affinity key; it rides the journal so a router can re-home
+        a dead worker's pending set by consistent hash."""
+        t = self.queue.submit(board, steps, self._clock(), session=session)
         if t.state == PENDING and self._wal is not None:
             # Instrumented crash site: admitted in memory, journal record
             # not yet written. A death here loses a ticket whose submit()
@@ -126,8 +134,59 @@ class ServingDaemon:
             # zero-ACKED-loss bound is intact.
             if chaos.crash_armed("post-admit"):
                 chaos.crash_now()
-            self._wal.admit(t.id, t.board, t.steps)
+            self._wal.admit(t.id, t.board, t.steps, session=t.session)
         return t
+
+    # -- fleet worker-mode hooks -------------------------------------------
+
+    def release(self, tickets: list[Ticket],
+                now: float | None = None) -> list[dict]:
+        """Hand a group of PENDING tickets off this worker's books — the
+        source half of a fleet re-home (wedged-worker drain) or a
+        whole-bucket work steal. Each ticket sheds terminally here with
+        the ``re-homed`` reason (journal frame first, so a later replay
+        of THIS worker's WAL never re-dispatches work that now lives
+        elsewhere) and comes back as a portable entry ``{board, steps,
+        session, queued_s, wall}`` for :meth:`adopt` on the destination.
+        Non-pending tickets are skipped — a result that already resolved
+        must not be recomputed under a new id."""
+        now = self._clock() if now is None else now
+        live = [t for t in tickets if t.state == PENDING]
+        wall = time.time()
+        entries = [
+            {"board": np.asarray(t.board), "steps": t.steps,
+             "session": t.session, "wall": wall,
+             "queued_s": t.queued_before_s + (now - t.submitted_at)}
+            for t in live
+        ]
+        self._shed_batch(live, policy_mod.SHED_REHOMED, now)
+        return entries
+
+    def adopt(self, entries: list[dict],
+              now: float | None = None) -> list[Ticket]:
+        """Admit re-homed/stolen entries (the destination half of
+        :meth:`release`, and what the router feeds from a dead worker's
+        WAL replay). No admission gate — the fleet already accepted this
+        work once — and the carried ``queued_s``/``wall`` keep each
+        ticket's end-to-end latency honest across the move. Adopted
+        tickets are journaled like fresh admissions, so a crash of the
+        ADOPTING worker re-homes them again instead of losing them."""
+        now = self._clock() if now is None else now
+        wall_now = time.time()
+        out = []
+        for e in entries:
+            queued = float(e.get("queued_s", 0.0))
+            wall = float(e.get("wall", 0.0))
+            if wall:
+                queued += max(0.0, wall_now - wall)
+            t = self.queue.restore_ticket(
+                e["board"], e["steps"], now, queued_s=queued,
+                session=e.get("session"))
+            if self._wal is not None:
+                self._wal.admit(t.id, t.board, t.steps,
+                                queued_s=queued, session=t.session)
+            out.append(t)
+        return out
 
     @classmethod
     def resume(cls, checkpoint_path: str,
@@ -210,7 +269,8 @@ class ServingDaemon:
                         # only clock that crosses a process boundary.
                         queued += max(0.0, wall_now - wall)
                     daemon.queue.restore_ticket(
-                        entry["board"], entry["steps"], now, queued_s=queued)
+                        entry["board"], entry["steps"], now, queued_s=queued,
+                        session=entry.get("session"))
                 daemon._compact_wal()
                 detail["wal_replay"] = rep.counts()
                 trace.event("serve.resume", source="wal",
@@ -314,7 +374,7 @@ class ServingDaemon:
         wall = time.time()
         entries = [
             {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
-             "wall": wall,
+             "wall": wall, "session": t.session,
              "queued_s": t.queued_before_s + (now - t.submitted_at)}
             for t in self.queue.pending()
         ]
@@ -482,6 +542,13 @@ class ServingDaemon:
             # in-flight batch) and redispatches them — dispatch is pure,
             # so the redo is idempotent.
             self._wal.dispatch_begin([t.id for t in live])
+        # Fleet chaos drill: kill_worker=<i>:<k> dies HERE, mid-dispatch
+        # — the DISPATCH frame is journaled, no RESOLVE ever will be, so
+        # the router's replay of this worker's WAL must surface the
+        # chunk as in-flight and re-home it (dispatch is pure; redoing
+        # it on a survivor is idempotent).
+        if chaos.kill_worker_armed(self.worker_index):
+            chaos.crash_now()
         shape = live[0].board.shape
         steps = live[0].steps
         padded = bucket_batch_size(
@@ -627,6 +694,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=60.0, metavar="S",
                    help="per-request end-to-end budget (default 60)")
     p.add_argument("--retries", type=int, default=2)
+    p.add_argument("--max-padding-frac", type=float, default=0.375,
+                   metavar="F",
+                   help="admission budget for estimated dead-padding "
+                   "fraction of the pending set (default %(default)s); "
+                   "fleet workers run heterogeneous budgets through "
+                   "this knob")
+    p.add_argument("--backoff", default="0.05:1.0:0.5", metavar="B[:C[:J]]",
+                   help="retry backoff schedule base[:cap[:jitter]] "
+                   "seconds (default %(default)s) — the "
+                   "capped-exponential ladder a full-ladder dispatch "
+                   "failure retries behind")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="queue drain checkpoint file (written on "
@@ -660,6 +738,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gate every resolved board bit-exact against the "
                    "NumPy oracle before reporting (CI smoke)")
     return p
+
+
+def _parse_backoff(spec: str) -> tuple[float, float, float]:
+    """``base[:cap[:jitter]]`` → the three ServePolicy backoff numbers
+    (missing fields keep the policy defaults)."""
+    parts = [p for p in str(spec).split(":") if p != ""]
+    if not 1 <= len(parts) <= 3:
+        raise ValueError(
+            f"--backoff wants base[:cap[:jitter]], got {spec!r}")
+    base = float(parts[0])
+    cap = float(parts[1]) if len(parts) > 1 else 1.0
+    jitter = float(parts[2]) if len(parts) > 2 else 0.5
+    return base, cap, jitter
 
 
 def _parse_shapes(spec: str) -> list[tuple[int, int]]:
@@ -707,10 +798,18 @@ def main(argv=None) -> int:
 
         aot = AOTCache(aot_dir)
         rec_aot_cache = os.path.abspath(aot_dir)
+    try:
+        backoff_base, backoff_cap, backoff_jitter = _parse_backoff(
+            args.backoff)
+    except ValueError as e:
+        build_parser().error(str(e))
     policy = ServePolicy(
         max_batch=args.max_batch, max_depth=args.max_depth,
+        max_padding_frac=args.max_padding_frac,
         max_wait_s=args.max_wait, request_timeout_s=args.timeout,
-        max_retries=args.retries, seed=args.seed)
+        max_retries=args.retries, backoff_base_s=backoff_base,
+        backoff_cap_s=backoff_cap, backoff_jitter=backoff_jitter,
+        seed=args.seed)
     rec: dict = {"daemon": "serve", "resume": bool(args.resume)}
     if aot is not None:
         rec["aot_cache"] = rec_aot_cache

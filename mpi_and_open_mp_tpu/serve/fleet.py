@@ -1,0 +1,459 @@
+"""Serving fleet harnesses: N workers under one FleetRouter.
+
+Two deployments of the same :class:`~mpi_and_open_mp_tpu.serve.router.
+FleetRouter` contract:
+
+* :class:`Fleet` — N in-process :class:`ServingDaemon` workers sharing
+  one injectable clock. This is what ``bench.py --serve --fleet N`` and
+  the unit tests drive: deterministic, no subprocess spawn tax, wedges
+  simulated by halting a worker's pump (its heartbeat stops, the router
+  declares it, the WAL replay + re-home ladder runs for real against
+  the worker's real journal).
+* The module CLI (``python -m mpi_and_open_mp_tpu.serve.fleet``) — the
+  cross-process deployment CI's ``fleet-chaos-smoke`` kills for real: a
+  parent partitions a seeded burst by consistent hash, writes one spool
+  per worker, spawns one subprocess per worker (``--worker-main``),
+  and when a worker dies (rc 137 from the ``kill_worker=<i>:<k>`` chaos
+  token — indistinguishable from ``kill -9``) replays the victim's WAL,
+  journals the ``re-homed`` sheds back to it, and spawns recovery
+  workers for the re-homed entries on the surviving ring. One JSON line
+  with the fleet books; the parity gate (``--verify``) covers every
+  resolved ticket INCLUDING the re-homed ones.
+
+The reference repo's answer to scale was a PBS multi-node launch
+(``qsub -l nodes=N`` + ``mpirun``) whose answer to failure was "requeue
+the whole job"; here the unit of failure is one worker, the unit of
+recovery is one ticket, and the books must balance fleet-wide either
+way (``docs/DESIGN.md`` §13).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.serve import policy as policy_mod
+from mpi_and_open_mp_tpu.serve import wal as wal_mod
+from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon, _parse_shapes
+from mpi_and_open_mp_tpu.serve.policy import ServePolicy, percentile
+from mpi_and_open_mp_tpu.serve.queue import DONE, Ticket
+from mpi_and_open_mp_tpu.serve.router import (
+    DEFAULT_MISS_K, DEFAULT_VNODES, FleetRouter)
+from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
+
+SPOOL_SCHEMA = "momp-fleet-spool/1"
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    """One worker as the router sees it: identity, daemon, journal
+    path, and liveness. ``halted`` is the in-process wedge simulation
+    (the fleet loop stops pumping it, so its heartbeat goes stale);
+    ``wedged`` is the router's verdict and is never cleared."""
+
+    index: int
+    daemon: ServingDaemon
+    wal_path: str | None = None
+    last_beat: float = 0.0
+    wedged: bool = False
+    halted: bool = False
+
+
+class Fleet:
+    """N in-process workers behind one router, one injectable clock.
+
+    ``policies`` (one per worker) overrides the uniform ``policy`` —
+    fleet workers may run heterogeneous budgets (the rollup projection
+    and the per-worker doors are exercised either way). With a
+    ``wal_dir`` every worker journals to ``<wal_dir>/worker<i>.wal``
+    and a wedge re-homes from the journal replay; without one the
+    re-home falls back to the live queue snapshot.
+    """
+
+    def __init__(self, n_workers: int, policy: ServePolicy | None = None,
+                 *, policies: list[ServePolicy] | None = None,
+                 wal_dir: str | None = None,
+                 wal_fsync: str = "every-record",
+                 heartbeat_interval_s: float = 0.02,
+                 heartbeat_miss_k: int = DEFAULT_MISS_K,
+                 steal: bool = True,
+                 vnodes: int = DEFAULT_VNODES, seed: int = 0,
+                 clock=time.monotonic, sleep=time.sleep):
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        if policies is not None and len(policies) != n_workers:
+            raise ValueError(
+                f"got {len(policies)} policies for {n_workers} workers")
+        if policies is None:
+            policies = [policy or ServePolicy()] * n_workers
+        self._clock = clock
+        self._sleep = sleep
+        self._steal_enabled = steal
+        self.handles: list[WorkerHandle] = []
+        for i in range(n_workers):
+            wal_path = (os.path.join(wal_dir, f"worker{i}.wal")
+                        if wal_dir else None)
+            d = ServingDaemon(policies[i], wal_path=wal_path,
+                              wal_fsync=wal_fsync, worker_index=i,
+                              clock=clock, sleep=sleep)
+            self.handles.append(WorkerHandle(
+                index=i, daemon=d, wal_path=wal_path, last_beat=clock()))
+        self.router = FleetRouter(
+            self.handles, vnodes=vnodes, seed=seed,
+            heartbeat_interval_s=heartbeat_interval_s,
+            heartbeat_miss_k=heartbeat_miss_k)
+
+    # -- traffic -----------------------------------------------------------
+
+    def submit(self, board, steps: int, session: str | None = None) -> Ticket:
+        return self.router.submit(board, steps, self._clock(),
+                                  session=session)
+
+    def wedge(self, index: int) -> None:
+        """Simulate a wedged worker: stop pumping it. Its heartbeat
+        goes stale and the ROUTER must notice (``check_health``) —
+        nothing here shortcuts the detection ladder."""
+        self.handles[index].halted = True
+
+    # -- the fleet loop ----------------------------------------------------
+
+    def pump(self, *, drain: bool = False) -> int:
+        """One fleet round: every live worker pumps (its beat), then
+        health check, then a steal round. Returns batches dispatched."""
+        n = 0
+        pumped = []
+        for h in self.handles:
+            if h.wedged or h.halted:
+                continue
+            n += h.daemon.pump(self._clock(), drain=drain)
+            pumped.append(h)
+        # One shared post-round beat: a worker that just pumped is alive
+        # by definition, however long the round took (first dispatches
+        # compile for whole seconds — per-worker stamps taken mid-round
+        # would look stale against the round-end clock and false-wedge
+        # healthy workers). Only never-pumped (halted) workers go stale.
+        now = self._clock()
+        for h in pumped:
+            h.last_beat = now
+        self.router.check_health(now)
+        if self._steal_enabled:
+            self.router.steal(self._clock())
+        return n
+
+    def pending(self) -> int:
+        return sum(h.daemon.queue.depth() for h in self.handles)
+
+    def serve_until_drained(self, *, drain: bool = False,
+                            timeout_s: float = 120.0) -> None:
+        """Pump until every admitted ticket fleet-wide is terminal. A
+        halted worker's pending set drains via the wedge ladder: its
+        beat goes stale while the loop idles, ``check_health`` declares
+        it, and the re-homed tickets finish on the survivors."""
+        start = self._clock()
+        while self.pending():
+            n = self.pump(drain=drain)
+            if n == 0:
+                self._sleep(max(1e-4, self.router.heartbeat_interval_s))
+            if self._clock() - start > timeout_s:
+                raise RuntimeError(
+                    f"fleet failed to drain within {timeout_s}s "
+                    f"({self.pending()} tickets pending)")
+        for h in self.handles:
+            if h.daemon._wal is not None and not h.wedged:
+                h.daemon._wal.sync()
+
+    # -- accounting --------------------------------------------------------
+
+    def resolved_tickets(self) -> list[Ticket]:
+        return [t for h in self.handles
+                for t in h.daemon.queue.tickets() if t.state == DONE]
+
+    def summary(self) -> dict:
+        """Fleet books + aggregate latency over every resolved ticket
+        (re-homed tickets carry their full cross-worker latency via the
+        queued-seconds carry)."""
+        books = self.router.books()
+        lat = [t.latency_s for t in self.resolved_tickets()]
+        books.update({
+            "workers": len(self.handles),
+            "wedged": list(self.router.wedged_workers),
+            "p50_latency_s": round(percentile(lat, 50), 6),
+            "p99_latency_s": round(percentile(lat, 99), 6),
+        })
+        return books
+
+
+# -- cross-process CLI -----------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_and_open_mp_tpu.serve.fleet",
+        description="Sharded serving fleet driver: partition a seeded "
+        "burst across N worker subprocesses by consistent-hash session "
+        "affinity, survive worker deaths by WAL replay + re-home, print "
+        "ONE JSON line with the fleet books. The MOMP_CHAOS "
+        "kill_worker=<i>:<k> token hard-kills worker <i> mid-dispatch "
+        "(rc 137) — the books must still balance with zero acked loss.")
+    p.add_argument("--workers", type=int, default=3, metavar="N")
+    p.add_argument("--requests", type=int, default=48, metavar="R")
+    p.add_argument("--sessions", type=int, default=12, metavar="S",
+                   help="distinct session keys cycled over the burst "
+                   "(default %(default)s)")
+    p.add_argument("--shapes", default="48x48,64x64", metavar="S")
+    p.add_argument("--steps", default="4,8", metavar="K")
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--max-depth", type=int, default=4096)
+    p.add_argument("--max-wait", type=float, default=0.02, metavar="S")
+    p.add_argument("--timeout", type=float, default=60.0, metavar="S")
+    p.add_argument("--max-padding-frac", type=float, default=0.375)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--vnodes", type=int, default=DEFAULT_VNODES)
+    p.add_argument("--dir", default=None, metavar="PATH",
+                   help="state directory for spools/journals/worker "
+                   "logs (default: a fresh temp dir)")
+    p.add_argument("--verify", action="store_true",
+                   help="each worker gates every resolved board "
+                   "bit-exact against the NumPy oracle — including the "
+                   "re-homed tickets on recovery workers")
+    # Internal: run as one fleet worker over a spool file.
+    p.add_argument("--worker-main", type=int, default=None, metavar="I",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--spool", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--wal", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def _policy(args) -> ServePolicy:
+    return ServePolicy(
+        max_batch=args.max_batch, max_depth=args.max_depth,
+        max_padding_frac=args.max_padding_frac,
+        max_wait_s=args.max_wait, request_timeout_s=args.timeout,
+        seed=args.seed)
+
+
+def _worker_main(args) -> int:
+    """One fleet worker: drain a spool under the full daemon contract
+    (WAL, chaos sites, supervision ladder), print one JSON line."""
+    idx = args.worker_main
+    spool = checkpoint_mod.restore_state(args.spool)
+    if spool.get("schema") != SPOOL_SCHEMA:
+        print(json.dumps({"worker": idx, "error": "bad spool schema"}))
+        return 1
+    daemon = ServingDaemon(_policy(args), wal_path=args.wal,
+                           worker_index=idx)
+    rehomed = [e for e in spool["entries"] if e.get("rehomed")]
+    fresh = [e for e in spool["entries"] if not e.get("rehomed")]
+    daemon.adopt(rehomed)
+    for e in fresh:
+        daemon.submit(e["board"], e["steps"], session=e.get("session"))
+    t0 = time.perf_counter()
+    try:
+        daemon.serve(watch_signals=True)
+    except Exception as e:  # noqa: BLE001 — the line IS the contract
+        print(json.dumps({"worker": idx,
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+        return 1
+    rec = {"worker": idx, "wall_sec": round(time.perf_counter() - t0, 4),
+           **{k: v for k, v in daemon.summary().items() if k != "engines"}}
+    if args.verify:
+        from mpi_and_open_mp_tpu.serve.daemon import _verify
+
+        rec["verified"] = _verify(daemon)
+    if daemon._wal is not None:
+        daemon._wal.close()
+    print(json.dumps(rec))
+    return 0 if (not args.verify or rec.get("verified")) else 1
+
+
+def _spawn_worker(args, idx: int, spool_path: str, wal_path: str,
+                  out_path: str, *, strip_chaos: bool = False):
+    cmd = [sys.executable, "-m", "mpi_and_open_mp_tpu.serve.fleet",
+           "--worker-main", str(idx), "--spool", spool_path,
+           "--wal", wal_path,
+           "--max-batch", str(args.max_batch),
+           "--max-depth", str(args.max_depth),
+           "--max-wait", str(args.max_wait),
+           "--timeout", str(args.timeout),
+           "--max-padding-frac", str(args.max_padding_frac),
+           "--seed", str(args.seed)]
+    if args.verify:
+        cmd.append("--verify")
+    env = dict(os.environ)
+    if strip_chaos:
+        # Recovery workers run clean by the same convention as the
+        # in-process ladder's chaos.suppressed(): the fault that killed
+        # the victim must not re-kill the redo.
+        env.pop("MOMP_CHAOS", None)
+    out = open(out_path, "wb")
+    err = open(out_path + ".err", "wb")
+    return subprocess.Popen(cmd, stdout=out, stderr=err, env=env)
+
+
+def _read_worker_line(out_path: str) -> dict | None:
+    try:
+        with open(out_path, "rb") as fd:
+            lines = [ln for ln in fd.read().decode(
+                "utf-8", "replace").splitlines() if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.worker_main is not None:
+        if not (args.spool and args.wal):
+            build_parser().error("--worker-main requires --spool and --wal")
+        return _worker_main(args)
+
+    from mpi_and_open_mp_tpu.serve.router import (
+        ConsistentHashRing, affinity_key)
+
+    state_dir = args.dir or tempfile.mkdtemp(prefix="momp-fleet-")
+    os.makedirs(state_dir, exist_ok=True)
+    n = args.workers
+    policy = _policy(args)
+    roll = policy_mod.rollup([policy] * n)
+    ring = ConsistentHashRing(range(n), vnodes=args.vnodes, seed=args.seed)
+
+    # Partition the seeded burst by session affinity, with the driver
+    # door applying the rolled-up + per-worker DEPTH budgets (padding
+    # projection stays at each worker's own door — the driver holds no
+    # queue to estimate against).
+    shapes = _parse_shapes(args.shapes)
+    step_list = [int(s) for s in args.steps.split(",")]
+    rng = np.random.default_rng(args.seed)
+    spools: dict[int, list[dict]] = {i: [] for i in range(n)}
+    door_shed = 0
+    for i in range(args.requests):
+        ny, nx = shapes[i % len(shapes)]
+        board = (rng.random((ny, nx)) < 0.3).astype(np.uint8)
+        session = f"s{i % max(1, args.sessions):04d}"
+        w = ring.lookup(affinity_key(session))
+        total = sum(len(v) for v in spools.values())
+        if total >= roll.max_depth or len(spools[w]) >= policy.max_depth:
+            door_shed += 1
+            continue
+        spools[w].append({"board": board, "steps":
+                          step_list[i % len(step_list)],
+                          "session": session})
+
+    t_start = time.perf_counter()
+    procs = {}
+    wal_paths = {}
+    for i in range(n):
+        spool_path = os.path.join(state_dir, f"worker{i}.spool")
+        wal_paths[i] = os.path.join(state_dir, f"worker{i}.wal")
+        checkpoint_mod.save_state(spool_path, {
+            "schema": SPOOL_SCHEMA, "worker": i, "entries": spools[i]})
+        procs[i] = _spawn_worker(
+            args, i, spool_path, wal_paths[i],
+            os.path.join(state_dir, f"worker{i}.out"))
+    rcs = {i: p.wait() for i, p in procs.items()}
+    lines = {i: _read_worker_line(os.path.join(state_dir, f"worker{i}.out"))
+             for i in range(n)}
+
+    # -- failure domain: replay each dead worker's WAL, re-home --------
+    victims = [i for i, rc in rcs.items() if rc != 0]
+    t_kill = time.perf_counter()
+    rehomed = 0
+    recovery_lines: list[dict] = []
+    recovery_rcs: list[int] = []
+    victim_resolved = victim_shed = 0
+    for v in victims:
+        rep = wal_mod.replay(wal_paths[v])
+        victim_resolved += len(rep.resolved_ids)
+        victim_shed += len(rep.shed_ids)
+        if not rep.pending:
+            continue
+        # Journal the re-homed sheds back to the victim so a SECOND
+        # replay (another recovery pass, forensics) finds nothing
+        # pending — the same idempotence the in-process router keeps.
+        w = wal_mod.TicketWAL(wal_paths[v])
+        w.shed([e["id"] for e in rep.pending], policy_mod.SHED_REHOMED)
+        w.close()
+        ring.remove_worker(v)
+        by_target: dict[int, list[dict]] = {}
+        for e in rep.pending:
+            key = affinity_key(e.get("session"), e.get("id"))
+            by_target.setdefault(ring.lookup(key), []).append(e)
+        rehomed += len(rep.pending)
+        for tgt, group in by_target.items():
+            spool_path = os.path.join(state_dir,
+                                      f"worker{tgt}.rehome{v}.spool")
+            checkpoint_mod.save_state(spool_path, {
+                "schema": SPOOL_SCHEMA, "worker": tgt,
+                "entries": [{**e, "rehomed": True} for e in group]})
+            out = os.path.join(state_dir, f"worker{tgt}.rehome{v}.out")
+            proc = _spawn_worker(
+                args, tgt, spool_path,
+                os.path.join(state_dir, f"worker{tgt}.rehome{v}.wal"),
+                out, strip_chaos=True)
+            recovery_rcs.append(proc.wait())
+            recovery_lines.append(_read_worker_line(out) or {})
+    recovery_s = time.perf_counter() - t_kill if victims else 0.0
+    wall = time.perf_counter() - t_start
+
+    # -- fleet books -------------------------------------------------------
+    survivor_lines = [lines[i] or {} for i in range(n) if i not in victims]
+    resolved = (sum(ln.get("resolved", 0) for ln in survivor_lines)
+                + victim_resolved
+                + sum(ln.get("resolved", 0) for ln in recovery_lines))
+    shed = (sum(ln.get("shed", 0) for ln in survivor_lines)
+            + victim_shed
+            + sum(ln.get("shed", 0) for ln in recovery_lines))
+    rehomed_resolved = sum(ln.get("resolved", 0) for ln in recovery_lines)
+    acked = args.requests - door_shed
+    acked_loss = acked - resolved - shed
+    verified = None
+    if args.verify:
+        verified = all(ln.get("verified", False)
+                       for ln in survivor_lines + recovery_lines)
+    rec = {
+        "fleet": n, "requests": args.requests, "sessions": args.sessions,
+        "door_shed": door_shed,
+        "worker_rcs": [rcs[i] for i in range(n)],
+        "victims": victims,
+        "recovery_rcs": recovery_rcs,
+        "rehomed": rehomed,
+        "rehomed_resolved": rehomed_resolved,
+        "resolved": resolved, "shed": shed,
+        "acked_loss": acked_loss,
+        "books_balance": acked_loss == 0,
+        "fleet_requests_per_sec": (round(resolved / wall, 2)
+                                   if wall > 0 and resolved else 0.0),
+        "fleet_p99_latency_s": round(max(
+            [ln.get("p99_latency_s", 0.0)
+             for ln in survivor_lines + recovery_lines] or [0.0]), 6),
+        "fleet_kill_recovery_s": round(recovery_s, 4),
+        "wall_sec": round(wall, 4),
+        "state_dir": state_dir,
+    }
+    if verified is not None:
+        rec["verified"] = verified
+        rec["rehomed_parity"] = all(
+            ln.get("verified", False) for ln in recovery_lines)
+    print(json.dumps(rec))
+    ok = (rec["books_balance"]
+          and all(rc == 0 for rc in recovery_rcs)
+          and all(rcs[i] in (0, 137) for i in range(n))
+          and (verified is None or verified))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

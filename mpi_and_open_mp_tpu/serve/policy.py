@@ -43,8 +43,15 @@ SHED_PADDING = "padding-waste"
 SHED_TIMEOUT = "timeout"
 #: Abandoned: every engine of every retry of the recovery ladder failed.
 SHED_DISPATCH = "dispatch-failed"
+#: Handed off: the ticket left THIS worker's books for another fleet
+#: worker (wedged-worker re-home or a whole-bucket work steal). Not a
+#: terminal outcome for the REQUEST — the router pairs every re-homed
+#: shed with an adoption elsewhere, and the fleet books count the
+#: request once, at its final owner.
+SHED_REHOMED = "re-homed"
 
-SHED_REASONS = (SHED_DEPTH, SHED_PADDING, SHED_TIMEOUT, SHED_DISPATCH)
+SHED_REASONS = (SHED_DEPTH, SHED_PADDING, SHED_TIMEOUT, SHED_DISPATCH,
+                SHED_REHOMED)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,6 +160,36 @@ def admit(policy: ServePolicy, depth: int,
                      policy.max_batch) > policy.max_padding_frac:
         return SHED_PADDING
     return None
+
+
+def rollup(policies: Iterable[ServePolicy]) -> ServePolicy:
+    """One fleet-wide admission projection over per-worker budgets — the
+    policy the router's door gate judges against BEFORE a request is
+    routed to its affinity worker.
+
+    Capacity budgets ADD across the fleet (``max_depth``: N workers
+    drain N queues concurrently) while every per-request knob takes the
+    most conservative worker's value (``max_padding_frac``, deadlines,
+    timeouts, retries): the door must never promise latitude some shard
+    cannot honor, or a hot shard wedges on work the fleet as a whole
+    "had room" for. ``max_batch`` takes the max — padding-waste
+    projection at the door needs the coarsest chunk quantum any worker
+    will actually pad with. Raises ``ValueError`` on an empty fleet."""
+    ps = list(policies)
+    if not ps:
+        raise ValueError("rollup: need at least one worker policy")
+    return ServePolicy(
+        max_batch=max(p.max_batch for p in ps),
+        max_depth=sum(p.max_depth for p in ps),
+        max_padding_frac=min(p.max_padding_frac for p in ps),
+        max_wait_s=min(p.max_wait_s for p in ps),
+        request_timeout_s=min(p.request_timeout_s for p in ps),
+        max_retries=min(p.max_retries for p in ps),
+        backoff_base_s=min(p.backoff_base_s for p in ps),
+        backoff_cap_s=min(p.backoff_cap_s for p in ps),
+        backoff_jitter=ps[0].backoff_jitter,
+        seed=ps[0].seed,
+    )
 
 
 def percentile(values: list[float], q: float) -> float:

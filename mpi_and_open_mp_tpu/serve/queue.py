@@ -48,6 +48,10 @@ class Ticket:
     engine: str | None = None  # provenance stamp of the resolving dispatch
     resolved_at: float | None = None
     resumed: bool = False  # restored from a drain checkpoint
+    #: Fleet affinity key: requests sharing a ``session`` route to the
+    #: same worker (consistent hash in ``serve.router``). ``None`` for
+    #: single-daemon use — affinity then falls back to a per-ticket key.
+    session: str | None = None
     #: Seconds this request already spent queued in PREVIOUS processes.
     #: ``submitted_at`` is re-stamped against the resuming clock
     #: (monotonic timestamps don't cross a process boundary), so without
@@ -80,7 +84,8 @@ class ServeQueue:
 
     # -- intake ------------------------------------------------------------
 
-    def submit(self, board: np.ndarray, steps: int, now: float) -> Ticket:
+    def submit(self, board: np.ndarray, steps: int, now: float,
+               session: str | None = None) -> Ticket:
         """Admit or reject one request; ALWAYS returns a ticket. A
         rejected ticket is already terminal (``SHED`` with the admission
         reason) so callers account for every submission the same way."""
@@ -93,7 +98,8 @@ class ServeQueue:
         steps = int(steps)
         if steps < 0:
             raise ValueError(f"submit: steps must be >= 0, got {steps}")
-        t = Ticket(self._next_ticket, board, steps, float(now))
+        t = Ticket(self._next_ticket, board, steps, float(now),
+                   session=session)
         self._next_ticket += 1
         counts = self._bucket_counts()
         counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
@@ -112,7 +118,8 @@ class ServeQueue:
         return t
 
     def restore_ticket(self, board: np.ndarray, steps: int,
-                       now: float, queued_s: float = 0.0) -> Ticket:
+                       now: float, queued_s: float = 0.0,
+                       session: str | None = None) -> Ticket:
         """Re-admit one drained ticket from a checkpoint — NO admission
         gate (it was already admitted once; dropping it now would break
         the never-lose-a-ticket contract). The deadline clock restarts at
@@ -122,7 +129,7 @@ class ServeQueue:
         from mpi_and_open_mp_tpu.obs import metrics
 
         t = Ticket(self._next_ticket, np.asarray(board), int(steps),
-                   float(now), resumed=True,
+                   float(now), resumed=True, session=session,
                    queued_before_s=float(queued_s))
         self._next_ticket += 1
         self._tickets[t.id] = t
@@ -250,6 +257,7 @@ class ServeQueue:
             "next_ticket": self._next_ticket,
             "pending": [
                 {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
+                 "session": t.session,
                  "queued_s": (t.queued_before_s
                               + (float(now) - t.submitted_at
                                  if now is not None else 0.0))}
@@ -280,5 +288,6 @@ class ServeQueue:
                 ) from e
             out.append(self.restore_ticket(
                 board, steps, now,
-                queued_s=float(item.get("queued_s", 0.0))))
+                queued_s=float(item.get("queued_s", 0.0)),
+                session=item.get("session")))
         return out

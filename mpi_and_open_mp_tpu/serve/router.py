@@ -1,0 +1,409 @@
+"""Fault-isolating fleet router: N worker daemons, one queue contract.
+
+One hardened :class:`~mpi_and_open_mp_tpu.serve.daemon.ServingDaemon` is
+a single failure domain — one wedge takes down the whole serving
+surface, and one queue cannot drain millions-of-users traffic. This
+module shards the EXISTING contract across a fleet: same
+:class:`~mpi_and_open_mp_tpu.serve.queue.Ticket` state machine, same
+``serve.policy`` shed vocabulary, same WAL/exit-75 semantics per worker
+— the router adds placement, global admission, and failure isolation on
+top, never a second request lifecycle. Four responsibilities:
+
+**Affinity** — :class:`ConsistentHashRing` maps a request's ``session``
+key to a worker through a hashlib-seeded virtual-node ring. The hash is
+``sha256`` over explicit strings, never Python's salted ``hash()``, so
+the mapping is identical in every process that builds the same ring —
+the cross-process determinism the fleet CLI leans on (the parent
+partitions a burst; each worker subprocess can recompute its own slice).
+Movement on resize is structurally bounded: removing a worker moves
+ONLY the sessions it owned (every other session's first clockwise point
+is untouched), adding one moves only sessions that now land on the new
+worker's points — expected ``sessions/(N+1)``, the bounded-movement
+property PAPERS.md's process-to-node mapping work asks of a placement
+function under topology change.
+
+**Global admission** — per-worker depth/padding budgets roll up into a
+single :func:`serve.policy.rollup` projection; the router's door judges
+the candidate against fleet-wide depth and the merged per-bucket
+padding estimate BEFORE routing, then the target worker's own door
+applies its local budgets. A hot shard therefore sheds (its own
+``queue-depth`` / ``padding-waste``) while cold shards keep admitting —
+overload degrades one shard's tail, not the fleet.
+
+**Work stealing** — an idle worker takes the oldest whole bucket from
+the deepest backlogged worker (:meth:`FleetRouter.steal`). Whole
+buckets only: a bucket is one compiled program's worth of same-shape
+work, and for bitsliced shapes one 32-board plane group — splitting it
+would spend two padded dispatches where one sufficed.
+
+**Failure isolation** — workers heartbeat by pumping; a worker that
+misses ``heartbeat_miss_k`` intervals is declared wedged
+(:meth:`FleetRouter.check_health`), its WAL is replayed BY THE ROUTER,
+and every pending/in-flight entry re-homes to the ring minus the
+victim. The DESIGN.md §10 acked-loss bounds survive fleet-wide: a
+re-homed ticket sheds ``re-homed`` at the source (journal frame first,
+so a second replay of the victim's WAL is idempotent) and adopts under
+a fresh journaled ADMIT at its new owner, so the fleet books —
+``admitted == resolved + shed + re-homed-resolved`` — balance with the
+request counted exactly once, at its final owner.
+
+The router is clock-free like ``ServeQueue`` (every decision takes
+``now``), owns no threads and no IO of its own, and works against any
+worker handle exposing ``index`` / ``daemon`` / ``wal_path`` /
+``last_beat`` / ``wedged`` — ``serve.fleet`` provides the in-process
+and subprocess harnesses.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.serve import policy as policy_mod
+from mpi_and_open_mp_tpu.serve.policy import ServePolicy
+from mpi_and_open_mp_tpu.serve.queue import PENDING, SHED, Ticket
+
+#: Virtual nodes per worker. 64 points spread each worker's arc finely
+#: enough that a 3-worker fleet shards a dozen sessions within ±2 of
+#: even (measured in the ring property tests) while ring rebuilds stay
+#: a few hundred hashes.
+DEFAULT_VNODES = 64
+
+#: Heartbeats a worker may miss before the router declares it wedged.
+DEFAULT_MISS_K = 3
+
+
+def _h64(s: str) -> int:
+    """First 8 bytes of sha256 as an int — deterministic across
+    processes and platforms (Python's builtin ``hash`` is salted per
+    process; a ring built on it would shard differently in every
+    worker)."""
+    return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "big")
+
+
+class ConsistentHashRing:
+    """Session→worker placement with bounded movement under resize.
+
+    Each worker owns ``vnodes`` pseudo-random points on a 2^64 ring;
+    a key maps to the worker owning the first point clockwise of the
+    key's hash. ``seed`` salts every hash input, so independent fleets
+    (or a test wanting a different shard pattern) get independent rings
+    while any two processes with the same ``(workers, vnodes, seed)``
+    agree exactly.
+    """
+
+    def __init__(self, workers=(), *, vnodes: int = DEFAULT_VNODES,
+                 seed: int = 0):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self._vnodes = int(vnodes)
+        self._seed = int(seed)
+        self._workers: set[int] = set()
+        self._points: list[tuple[int, int]] = []  # (ring point, worker)
+        self._keys: list[int] = []
+        for w in workers:
+            self._workers.add(int(w))
+        self._rebuild()
+
+    @property
+    def workers(self) -> tuple[int, ...]:
+        return tuple(sorted(self._workers))
+
+    def _rebuild(self) -> None:
+        pts = []
+        for w in self._workers:
+            for r in range(self._vnodes):
+                pts.append((_h64(f"momp-fleet/{self._seed}/w{w}/{r}"), w))
+        pts.sort()
+        self._points = pts
+        self._keys = [p for p, _ in pts]
+
+    def add_worker(self, worker: int) -> None:
+        self._workers.add(int(worker))
+        self._rebuild()
+
+    def remove_worker(self, worker: int) -> None:
+        self._workers.discard(int(worker))
+        self._rebuild()
+
+    def lookup(self, key: str) -> int:
+        """The worker owning ``key``. Raises on an empty ring — routing
+        with zero live workers is a fleet-down condition the caller must
+        surface, not a placement question."""
+        if not self._points:
+            raise RuntimeError("consistent-hash ring has no live workers")
+        h = _h64(f"momp-fleet/{self._seed}/key/{key}")
+        i = bisect.bisect_right(self._keys, h) % len(self._points)
+        return self._points[i][1]
+
+
+def affinity_key(session: str | None, ticket_id: int | None = None) -> str:
+    """The ring key for a request: its ``session`` when it has one, else
+    a per-ticket key (no affinity to preserve — spread it)."""
+    if session is not None:
+        return str(session)
+    return f"ticket/{ticket_id if ticket_id is not None else 0}"
+
+
+class FleetRouter:
+    """The fault-isolating front of a worker fleet.
+
+    ``workers`` are handles with ``index`` (stable int id), ``daemon``
+    (a :class:`ServingDaemon`), ``wal_path`` (``None`` = re-home from
+    the live queue instead of a journal replay), ``last_beat``
+    (caller-maintained monotonic stamp) and ``wedged`` (set by the
+    router, never cleared — a wedged worker leaves the fleet). The
+    router never advances clocks: the fleet loop stamps beats and
+    passes ``now``.
+    """
+
+    def __init__(self, workers, *, vnodes: int = DEFAULT_VNODES,
+                 seed: int = 0, heartbeat_interval_s: float = 0.05,
+                 heartbeat_miss_k: int = DEFAULT_MISS_K):
+        ws = list(workers)
+        if not ws:
+            raise ValueError("FleetRouter needs at least one worker")
+        if heartbeat_miss_k < 1:
+            raise ValueError(
+                f"heartbeat_miss_k must be >= 1, got {heartbeat_miss_k}")
+        self._workers: dict[int, object] = {w.index: w for w in ws}
+        if len(self._workers) != len(ws):
+            raise ValueError("worker indices must be unique")
+        self.ring = ConsistentHashRing(self._workers, vnodes=vnodes,
+                                       seed=seed)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.heartbeat_miss_k = int(heartbeat_miss_k)
+        self._rollup = policy_mod.rollup(
+            w.daemon.policy for w in self.live_workers())
+        # Door accounting: submissions the ROUTER refused before any
+        # worker saw them (fleet-wide budget breach).
+        self.door_shed: dict[str, int] = {}
+        self.submitted = 0
+        self.rehomes = 0  # re-home MOVES (one ticket moved twice = 2)
+        self.steals = 0
+        self.wedged_workers: list[int] = []
+        #: Tickets adopted during the most recent wedge re-home — the
+        #: bench kill drill reads their ``resolved_at`` stamps to
+        #: measure recovery time.
+        self.last_rehomed: list[Ticket] = []
+
+    # -- topology ----------------------------------------------------------
+
+    def live_workers(self) -> list:
+        return [w for w in self._workers.values() if not w.wedged]
+
+    def worker(self, index: int):
+        return self._workers[index]
+
+    def _recompute_rollup(self) -> None:
+        live = self.live_workers()
+        if live:
+            self._rollup = policy_mod.rollup(w.daemon.policy for w in live)
+
+    # -- routing + global admission ----------------------------------------
+
+    def target_for(self, session: str | None) -> int:
+        """Affinity worker index for a session (ring over LIVE workers
+        only — wedged workers left the ring when declared)."""
+        return self.ring.lookup(affinity_key(session, self.submitted))
+
+    def submit(self, board, steps: int, now: float,
+               session: str | None = None) -> Ticket:
+        """Route one request. Door order: (1) fleet-wide budget — the
+        rolled-up depth cap and the padding estimate over every live
+        worker's pending buckets plus the candidate; (2) the affinity
+        worker's own door (its local depth/padding budgets — the
+        hot-shard shed). Always returns a ticket; a router-door shed is
+        terminal with the standard vocabulary reason, owned by no
+        worker (it never existed anywhere worth replaying)."""
+        self.submitted += 1
+        board = np.asarray(board)
+        target = self._workers[self.target_for(session)]
+        reason = self._door_verdict(board, steps, target)
+        if reason is not None:
+            self.door_shed[reason] = self.door_shed.get(reason, 0) + 1
+            t = Ticket(-self.submitted, board, int(steps), float(now),
+                       state=SHED, reason=reason, resolved_at=float(now),
+                       session=session)
+            return t
+        return target.daemon.submit(board, steps, session=session)
+
+    def _door_verdict(self, board, steps: int, target) -> str | None:
+        depth = 0
+        counts: dict[tuple, int] = {}
+        widths: dict[tuple, int | None] = {}
+        for w in self.live_workers():
+            q = w.daemon.queue
+            depth += q.depth()
+            for key, n in q._bucket_counts().items():
+                counts[key] = counts.get(key, 0) + n
+                widths.setdefault(key, q._slice_width(key))
+        cand = ((board.shape, board.dtype.str, int(steps)))
+        counts[cand] = counts.get(cand, 0) + 1
+        widths.setdefault(cand, target.daemon.queue._slice_width(cand))
+        return policy_mod.admit(
+            self._rollup, depth,
+            [(n, widths[key]) for key, n in counts.items()])
+
+    # -- failure isolation -------------------------------------------------
+
+    def check_health(self, now: float) -> list[int]:
+        """Declare every worker whose beat is older than
+        ``miss_k * interval`` wedged and re-home its pending set.
+        Returns the indices declared THIS call."""
+        horizon = self.heartbeat_miss_k * self.heartbeat_interval_s
+        declared = []
+        for w in list(self.live_workers()):
+            if len(self.live_workers()) <= 1:
+                break  # nobody left to re-home onto
+            if now - w.last_beat > horizon:
+                self.declare_wedged(w.index, now)
+                declared.append(w.index)
+        return declared
+
+    def declare_wedged(self, index: int, now: float) -> list[Ticket]:
+        """The isolation ladder for one failed worker: out of the ring →
+        WAL replay (the durable truth; the live queue only cross-checks
+        it) → ``re-homed`` sheds journaled back to the victim → adoption
+        on the survivors by consistent hash. Returns the adopted
+        tickets (also kept in :attr:`last_rehomed`)."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        victim = self._workers[index]
+        if victim.wedged:
+            return []
+        survivors = [w for w in self.live_workers() if w.index != index]
+        if not survivors:
+            raise RuntimeError(
+                f"worker {index} wedged with no survivors to re-home to")
+        victim.wedged = True
+        self.ring.remove_worker(index)
+        self.wedged_workers.append(index)
+        self._recompute_rollup()
+
+        entries = self._drain_victim(victim, now)
+        adopted: list[Ticket] = []
+        by_target: dict[int, list[dict]] = {}
+        for e in entries:
+            key = affinity_key(e.get("session"), e.get("id"))
+            by_target.setdefault(self.ring.lookup(key), []).append(e)
+        for tgt_index, group in by_target.items():
+            adopted.extend(
+                self._workers[tgt_index].daemon.adopt(group, now))
+        self.rehomes += len(entries)
+        self.last_rehomed = adopted
+        metrics.inc("serve.fleet.wedged")
+        metrics.inc("serve.fleet.rehomed", len(entries))
+        trace.event("serve.fleet.wedged", worker=index,
+                    rehomed=len(entries),
+                    survivors=len(survivors))
+        return adopted
+
+    def _drain_victim(self, victim, now: float) -> list[dict]:
+        """The victim's outstanding entries, from its journal when it
+        has one (a wedged process's memory is not trustworthy; its WAL
+        is), else from the live queue. Either way the victim's own books
+        close: every drained ticket sheds ``re-homed`` in its queue and
+        — via :meth:`ServingDaemon.release` — in its journal, so a
+        second replay finds nothing pending."""
+        from mpi_and_open_mp_tpu.serve import wal as wal_mod
+
+        pending = victim.daemon.queue.pending()
+        if victim.wal_path is None:
+            return victim.daemon.release(pending, now)
+        rep = wal_mod.replay(victim.wal_path)
+        # Close the in-memory books with the same re-homed sheds (this
+        # also appends the SHED frames that make the journal replay
+        # idempotent). In-process the two views must agree; the journal
+        # wins on any disagreement because it is what a cross-process
+        # recovery would see.
+        victim.daemon.release(pending, now)
+        entries = []
+        for e in rep.pending:
+            entries.append({
+                "id": e["id"], "board": e["board"], "steps": e["steps"],
+                "session": e.get("session"), "wall": e.get("wall", 0.0),
+                "queued_s": e.get("queued_s", 0.0),
+            })
+        return entries
+
+    # -- work stealing -----------------------------------------------------
+
+    def steal(self, now: float) -> int:
+        """Move the oldest whole bucket from the deepest backlogged
+        worker to an idle one. Whole buckets only — a bucket is one
+        compiled program's worth of same-shape work (one 32-board plane
+        group when bitsliced); splitting it buys a second padded
+        dispatch for zero latency win. The donor keeps at least one
+        bucket (stealing its last one just moves the wait). Returns the
+        number of tickets moved (0 = no steal this round)."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        live = self.live_workers()
+        idle = [w for w in live if w.daemon.queue.depth() == 0]
+        if not idle:
+            return 0
+        donors = [(w.daemon.queue.depth(), w) for w in live
+                  if len(w.daemon.queue.buckets()) >= 2]
+        if not donors:
+            return 0
+        _, donor = max(donors, key=lambda dw: dw[0])
+        buckets = donor.daemon.queue.buckets()
+        # Oldest lead ticket first: that bucket has waited longest and
+        # the idle worker will dispatch it immediately.
+        _, group = min(buckets.items(), key=lambda kv: kv[1][0].id)
+        thief = min(idle, key=lambda w: w.index)
+        entries = donor.daemon.release(group, now)
+        thief.daemon.adopt(entries, now)
+        self.steals += 1
+        self.rehomes += len(entries)
+        metrics.inc("serve.fleet.steals")
+        trace.event("serve.fleet.steal", donor=donor.index,
+                    thief=thief.index, tickets=len(entries))
+        return len(entries)
+
+    # -- accounting --------------------------------------------------------
+
+    def books(self) -> dict:
+        """Fleet-wide accounting across every worker that ever held a
+        ticket. Each request is counted once, at its final owner: a
+        re-home is one ``re-homed`` shed at the source plus one adopted
+        ticket at the destination, and the two must cancel —
+        ``balanced`` asserts both the shed/adopt pairing and the ISSUE
+        equation ``admitted == resolved + shed + pending`` with
+        re-homed moves netted out."""
+        admitted = resolved = shed_real = rehomed_shed = pending = 0
+        adopted = rehomed_resolved = 0
+        for w in self._workers.values():
+            for t in w.daemon.queue.tickets():
+                if t.resumed:
+                    adopted += 1
+                else:
+                    admitted += 1
+                if t.state == PENDING:
+                    pending += 1
+                elif t.reason == policy_mod.SHED_REHOMED:
+                    rehomed_shed += 1
+                elif t.state == SHED:
+                    shed_real += 1
+                else:
+                    resolved += 1
+                    if t.resumed:
+                        rehomed_resolved += 1
+        door = sum(self.door_shed.values())
+        return {
+            "submitted": self.submitted,
+            "door_shed": door,
+            "admitted": admitted,
+            "resolved": resolved,
+            "shed": shed_real,
+            "pending": pending,
+            "rehomed": rehomed_shed,
+            "rehomed_resolved": rehomed_resolved,
+            "steals": self.steals,
+            "balanced": (rehomed_shed == adopted
+                         and admitted == resolved + shed_real + pending
+                         and self.submitted == admitted + door),
+        }

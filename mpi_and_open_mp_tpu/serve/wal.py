@@ -22,10 +22,14 @@ File format (``momp-serve-wal/1``)::
 
 Record types and what :func:`replay` does with them:
 
-``ADMIT {id, board, steps, wall, queued_s}``
+``ADMIT {id, board, steps, wall, queued_s[, session]}``
     Ticket enters the pending set. ``wall`` is ``time.time()`` at the
     append (monotonic clocks don't survive a process boundary; wall time
     lets the resuming process carry true queued seconds forward).
+    ``session`` is the optional fleet affinity key — the router re-homes
+    a dead worker's pending set by consistent-hashing it, so the key
+    must survive the journal round trip (absent in pre-fleet journals;
+    replay surfaces ``None``).
 ``DISPATCH {ids}``
     A chunk went to the engines. Pending membership is unchanged — a
     ``DISPATCH`` without a later ``RESOLVE``/``SHED`` covering its ids
@@ -225,6 +229,7 @@ def replay(path: str | os.PathLike) -> WALReplay:
                 "steps": int(rec["steps"]),
                 "wall": float(rec.get("wall", 0.0)),
                 "queued_s": float(rec.get("queued_s", 0.0)),
+                "session": rec.get("session"),
             }
         elif rtype == "DISPATCH":
             for tid in rec["ids"]:
@@ -269,6 +274,7 @@ def replay(path: str | os.PathLike) -> WALReplay:
                     "steps": int(entry["steps"]),
                     "wall": float(entry.get("wall", 0.0)),
                     "queued_s": float(entry.get("queued_s", 0.0)),
+                    "session": entry.get("session"),
                 }
         else:
             raise ValueError(
@@ -332,12 +338,14 @@ class TicketWAL:
     # -- record appends ----------------------------------------------------
 
     def admit(self, ticket_id: int, board, steps: int, *,
-              wall: float | None = None, queued_s: float = 0.0) -> None:
+              wall: float | None = None, queued_s: float = 0.0,
+              session: str | None = None) -> None:
         self._append("ADMIT", {
             "id": int(ticket_id), "board": np.asarray(board),
             "steps": int(steps),
             "wall": time.time() if wall is None else float(wall),
             "queued_s": float(queued_s),
+            "session": session,
         })
 
     def dispatch_begin(self, ticket_ids: list[int]) -> None:
@@ -369,6 +377,7 @@ class TicketWAL:
             "id": int(e["id"]), "board": np.asarray(e["board"]),
             "steps": int(e["steps"]), "wall": float(e.get("wall", 0.0)),
             "queued_s": float(e.get("queued_s", 0.0)),
+            "session": e.get("session"),
         } for e in pending_entries]
         with trace.span("serve.wal.compact", generation=gen,
                         pending=len(entries)):
