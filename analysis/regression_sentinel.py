@@ -92,6 +92,17 @@ WATCH_FIELDS = (
     "fleet_requests_per_sec",
     "fleet_p99_latency_s",
     "fleet_kill_recovery_s",
+    # Device-resident session pool (PR 12): the resident step rate, its
+    # ratio over the ship-boards-every-call baseline measured in the
+    # same process (RTT- and noise-cancelled, like vs_cellpacked), the
+    # resident-path latency tail, and the pool's eviction count for the
+    # phase — evictions climbing at fixed session count means the
+    # residency budget or the compactor regressed (``evict`` is in the
+    # lower-is-better vocabulary).
+    "session_requests_per_sec",
+    "session_vs_ship",
+    "session_p99_latency_s",
+    "pool_evictions",
 )
 
 
@@ -112,6 +123,7 @@ def direction_for(field: str) -> str:
     if "per_sec" in field or "cups" in field or "tflops" in field:
         return "higher"
     if ("latency" in field or "shed" in field or "degrad" in field
+            or "evict" in field
             or field.endswith(("_sec", "_seconds", "_s", "_bytes"))):
         return "lower"
     return "higher"
@@ -121,7 +133,7 @@ PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
                      "attention_engine", "attention_hop_engine",
                      "attention_hop_engine_bwd")
 
-DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch")
+DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch", "resident")
 
 _BACKEND_RANK = {"cpu": 0, "gpu": 1, "tpu": 2}
 

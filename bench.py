@@ -502,6 +502,108 @@ def _fleet_phase(n: int, workers: int) -> dict:
     return fields
 
 
+def _sessions_phase(s: int) -> dict:
+    """The resident-session phase (``--sessions S``): the device-resident
+    A/B that prices what the session pool exists for. Side A (resident):
+    S sessions created once into the daemon's ``serve.pool`` — boards
+    cross the wire at create, then ``rounds`` rounds of one 4-step
+    resident step per session, each round one in-place donated dispatch
+    per slab, results never shipped back. Side B (ship): the identical
+    workload through the plain ticket path — every round re-ships every
+    board to the daemon and fetches the stepped board back, the
+    per-request round trip the reference workflow (and PR 5-11 serving)
+    always paid. Same seed, same boards, same total Life steps; only the
+    residency discipline differs, so ``session_vs_ship`` is an RTT- and
+    machine-noise-cancelled ratio (like ``vs_cellpacked``). Honesty
+    gate: every final session snapshot must be bit-exact against the
+    NumPy oracle advanced ``rounds * steps`` from the seed board before
+    any number is recorded. Session creation happens OUTSIDE the timed
+    bracket — the phase prices steady-state resident stepping, and the
+    one-time create cost is exactly what the ship side pays per round.
+    """
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
+    from mpi_and_open_mp_tpu.serve.queue import DONE
+
+    shape = (48, 48)
+    steps_per_round = 4
+    rounds = 8
+    policy = ServePolicy(max_batch=8, max_depth=max(64, 4 * s),
+                         max_wait_s=0.0)
+    rng = np.random.default_rng(48)
+    boards0 = {f"sess{i:04d}": (rng.random(shape) < 0.3).astype(np.uint8)
+               for i in range(s)}
+
+    # Side A: resident. Creates ship each board once; the timed bracket
+    # is pure resident stepping (handle-based submits, in-place slab
+    # dispatches, zero result traffic).
+    daemon = ServingDaemon(policy)
+    for sid, b in boards0.items():
+        daemon.create_session(sid, b)
+    res_tickets = []
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for sid in boards0:
+            res_tickets.append(daemon.submit_session(sid, steps_per_round))
+        daemon.pump(drain=True)
+    res_wall = time.perf_counter() - t0
+    res_done = sum(1 for t in res_tickets if t.state == DONE)
+    rs = daemon.summary()
+
+    bad = 0
+    for sid, b in boards0.items():
+        ref = b.copy()
+        for _ in range(rounds * steps_per_round):
+            ref = life_step_numpy(ref)
+        if not np.array_equal(daemon.snapshot_session(sid), ref):
+            bad += 1
+
+    # Side B: ship-every-call. The same boards advance the same total
+    # steps, but each round round-trips every board through the ticket
+    # path (host -> queue -> stacked dispatch -> host), chained so round
+    # k+1 ships what round k fetched — the honest no-pool workflow.
+    ship = ServingDaemon(policy)
+    cur = {sid: b.copy() for sid, b in boards0.items()}
+    ship_done = 0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        tks = {sid: ship.submit(cur[sid], steps_per_round) for sid in cur}
+        ship.pump(drain=True)
+        for sid, t in tks.items():
+            if t.state == DONE:
+                ship_done += 1
+                cur[sid] = np.asarray(t.result)
+    ship_wall = time.perf_counter() - t0
+
+    res_rate = round(res_done / res_wall, 2) if res_wall > 0 else None
+    ship_rate = round(ship_done / ship_wall, 2) if ship_wall > 0 else None
+    fields = {
+        "resident": "pool",
+        "session_count": s,
+        "session_rounds": rounds,
+        "session_steps_per_round": steps_per_round,
+        "session_requests": res_done,
+        "session_requests_per_sec": res_rate,
+        "ship_requests_per_sec": ship_rate,
+        "session_vs_ship": (round(res_rate / ship_rate, 2)
+                            if res_rate and ship_rate else None),
+        "session_p50_latency_s": rs["p50_latency_s"],
+        "session_p99_latency_s": rs["p99_latency_s"],
+        "session_dispatches": rs["batches"],
+        "pool_sessions": rs["pool_sessions"],
+        "pool_hits": rs["pool_hits"],
+        "pool_misses": rs["pool_misses"],
+        "pool_evictions": rs["pool_evictions"],
+        "pool_spills": rs["pool_spills"],
+        "pool_compactions": rs["pool_compactions"],
+        "session_parity": bad == 0,
+    }
+    if bad:
+        fields["session_error"] = (
+            f"snapshot parity failed on {bad} of {s} sessions")
+    return fields
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--board", type=int, default=None, metavar="N",
@@ -549,6 +651,17 @@ def main(argv=None) -> int:
                     "heartbeat->WAL-replay->re-home ladder is priced "
                     "(fleet_kill_recovery_s); fleet books must balance "
                     "and every re-homed board is oracle-parity-gated")
+    ap.add_argument("--sessions", type=int, default=0, metavar="S",
+                    help="also run the RESIDENT-SESSION phase: S "
+                    "device-resident sessions in the serving daemon's "
+                    "session pool (serve.pool — boards live on device as "
+                    "(slab, bit-lane) handles, stepping is in-place "
+                    "donated dispatch) vs the identical workload shipped "
+                    "board-by-board through the ticket path, reporting "
+                    "session_requests_per_sec / ship_requests_per_sec / "
+                    "session_vs_ship plus pool hit/miss/evict accounting; "
+                    "every final snapshot is oracle-parity-gated (runs on "
+                    "every backend)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="write obs span/event JSONL here (sets MOMP_TRACE; "
                     "summarise with analysis/trace_report.py). The timed "
@@ -827,6 +940,23 @@ def _bench(args, state) -> int:
                     served.update({"fleet_workers": args.fleet,
                                    "fleet_error":
                                    f"{type(e).__name__}: {e}"[:200]})
+
+    # Resident-session phase (opt-in via --sessions S): the device-
+    # resident vs ship-every-call A/B through the session pool. Same
+    # failure contract as the other serve-layer phases.
+    if args.sessions:
+        from mpi_and_open_mp_tpu.robust.preempt import Preempted
+
+        state["phase"] = "sessions"
+        with obs_trace.span("bench.phase", phase="sessions"):
+            try:
+                served.update(_sessions_phase(args.sessions))
+            except Preempted:
+                raise
+            except Exception as e:
+                served.update({"session_count": args.sessions,
+                               "session_error":
+                               f"{type(e).__name__}: {e}"[:200]})
 
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device

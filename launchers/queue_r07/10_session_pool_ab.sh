@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# r07 queued increment (ISSUE 12, DESIGN.md §14): device-resident
+# session-pool A/B on the real chip — 32 resident sessions stepped
+# through (slab, bit-lane) handles vs the same workload shipped
+# board-by-board through the ticket path. On TPU the ship side pays the
+# ~70 ms relay RTT per round both ways; the resident side pays it only
+# at create, so session_vs_ship here is the number the pool exists for.
+# The line lands in MOMP_LEDGER (exported by tpu_queue_loop.sh) stamped
+# resident=pool, giving the sentinel its session_* baseline; parity is
+# gated in-phase (session_parity) before any number is recorded. One
+# chip process; exits nonzero on failure so the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python bench.py --sessions 32

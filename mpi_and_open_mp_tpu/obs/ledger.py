@@ -47,9 +47,11 @@ ENV = "MOMP_LEDGER"
 #: ``batch_pack_layout`` joined in PR 10: a bitsliced and a cell-packed
 #: run of the same stack are different configurations (the sentinel
 #: treats bitsliced → cell-packed as a provenance downgrade, same as
-#: pallas → jnp).
+#: pallas → jnp). ``resident`` joined in PR 12: a device-resident
+#: session-pool run and a ship-boards-every-call run measure different
+#: serving disciplines, so they must never share a baseline group.
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
-              "batch_pack_layout", "engine")
+              "batch_pack_layout", "resident", "engine")
 
 _GIT_SHA: str | None = None
 
@@ -108,6 +110,9 @@ def stamp(record: dict, *, source: str = "bench.py",
         # "-" for non-batched lines (no stack, no pack layout); batched
         # lines carry the closed vocabulary {cell-packed, bitsliced}.
         "batch_pack_layout": record.get("batch_pack_layout", "-"),
+        # "-" for lines without a sessions phase; "pool" when the record
+        # carries device-resident session-pool measurements.
+        "resident": record.get("resident", "-"),
         "engine": record.get("impl", "?"),
     }
     return {
@@ -155,11 +160,18 @@ def load(path: str) -> list[dict]:
     return entries
 
 
+#: Key fields whose absence means "not applicable" rather than
+#: "unrecorded": entries stamped before the field joined KEY_FIELDS must
+#: keep matching new lines that carry the explicit "-" placeholder.
+_KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-"}
+
+
 def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:
     """Render an entry's key (or any subset of it) as a stable string,
     e.g. ``metric=life_steady_cups_p46gun_big|shape=500x500|batch=0``."""
     key = entry.get("key") or {}
-    return "|".join(f"{f}={key.get(f, '?')}" for f in fields)
+    return "|".join(
+        f"{f}={key.get(f, _KEY_DEFAULTS.get(f, '?'))}" for f in fields)
 
 
 def query(entries: list[dict], **where) -> list[dict]:

@@ -48,7 +48,12 @@ Tokens:
     ``post-dispatch`` (batch computed, RESOLVE record NOT yet
     journaled). The write-ahead journal's crash-matrix test drives all
     three to prove the per-fsync-policy loss bounds in
-    ``serve/wal.py``.
+    ``serve/wal.py``. The session-pool lifecycle adds four more, each
+    firing AFTER its handle-lifecycle frame is journaled but BEFORE the
+    pool action runs: ``post-create``, ``post-step``, ``post-snapshot``,
+    ``post-evict`` — the pool crash matrix proves resume re-materializes
+    exactly the journaled state (a journaled-but-unapplied step is
+    applied on resume; nothing acked is ever lost).
 ``kill_worker=<i>:<k>``
     Fleet drill: hard-kill (``os._exit(137)``) the serving worker whose
     ``worker_index`` is ``<i>`` on its ``<k>``-th batch dispatch, after
@@ -92,7 +97,8 @@ _HOP_KINDS = ("nan", "inf")
 _HALO_KINDS = ("corrupt", "drop")
 
 #: Instrumented hard-kill sites for the ``crash=<site>:<k>`` token.
-CRASH_SITES = ("post-admit", "mid-frame", "post-dispatch")
+CRASH_SITES = ("post-admit", "mid-frame", "post-dispatch",
+               "post-create", "post-step", "post-snapshot", "post-evict")
 
 #: The exit status a hard kill reports — 128+SIGKILL, so a requeue loop
 #: or CI harness cannot tell an injected crash from a real ``kill -9``.

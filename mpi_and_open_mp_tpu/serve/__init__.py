@@ -59,6 +59,11 @@ from mpi_and_open_mp_tpu.serve.wal import (  # noqa: F401
     replay,
 )
 from mpi_and_open_mp_tpu.serve.aotcache import AOTCache  # noqa: F401
+from mpi_and_open_mp_tpu.serve.pool import (  # noqa: F401
+    Handle,
+    PoolError,
+    SessionPool,
+)
 from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon  # noqa: F401
 from mpi_and_open_mp_tpu.serve.router import (  # noqa: F401
     ConsistentHashRing,

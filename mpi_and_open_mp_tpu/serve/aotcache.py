@@ -102,9 +102,16 @@ def code_fingerprint() -> str:
     return _CODE_FP
 
 
-def fingerprint(stack_shape: tuple[int, int, int], dtype) -> dict:
-    """The full cache key for one bucket program — everything that can
-    change the compiled executable or its validity."""
+def fingerprint(stack_shape: tuple[int, int, int], dtype, *,
+                program: str = "bucket", donated: bool = False) -> dict:
+    """The full cache key for one compiled program — everything that can
+    change the executable or its validity. ``program`` names which
+    program family the key identifies (``"bucket"`` for the daemon's
+    padded batch programs, ``"pool-step"`` for the session pool's
+    donated in-place step); ``donated`` is keyed because input aliasing
+    changes the executable's buffer contract even at identical shapes.
+    Donation does not survive ``jax.export``, so pool-step keys are
+    identity stamps for the in-process jit cache, never load targets."""
     import jax
     import jaxlib
 
@@ -121,6 +128,8 @@ def fingerprint(stack_shape: tuple[int, int, int], dtype) -> dict:
         "shape": [ny, nx],
         "dtype": str(np.dtype(dtype)),
         "bucket": b,
+        "program": str(program),
+        "donated": bool(donated),
         "steps": STEPS_SIGNATURE,
         "engine_path": "batch:" + pallas_life.native_path_batch(
             (b, ny, nx), on_tpu=on_tpu),

@@ -21,6 +21,7 @@ _BATCH_FNS = (
     "life_batch_xla",
     "life_batch_fused",
     "life_batch_frame",
+    "pool_step",
 )
 
 
@@ -112,16 +113,23 @@ class ShapeBucketBatcher:
     run can audit exactly how many programs served how many requests.
     """
 
-    def __init__(self, max_batch: int = 8):
+    def __init__(self, max_batch: int = 8, pool=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = int(max_batch)
         self._queue: list[_Request] = []
+        # Resident-session step requests, keyed for slab-group
+        # coalescing: sessions whose lanes share a slab ride ONE
+        # in-place masked dispatch even below BITSLICE_MIN_BATCH — the
+        # mask is runtime data, so a lone lane and 32 slab-mates are the
+        # same compiled program (``jit.retrace{fn=pool_step}``).
+        self._pool = pool
+        self._session_queue: list[tuple[int, str, int]] = []
         self._next_ticket = 0
         self.last_flush_stats: list[_BatchStat] = []
 
     def __len__(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._session_queue)
 
     def submit(self, board: np.ndarray, steps: int) -> int:
         """Enqueue one board for ``steps`` Life steps; returns a ticket
@@ -139,12 +147,38 @@ class ShapeBucketBatcher:
         self._queue.append(_Request(ticket, board, steps))
         return ticket
 
+    def submit_session(self, session: str, steps: int) -> int:
+        """Enqueue one resident-session step (requires a ``pool``).
+        Returns a ticket like :meth:`submit`; the flush result for a
+        resident step is ``None`` — the board stays on device, that
+        being the point."""
+        if self._pool is None:
+            raise ValueError(
+                "submit_session: this batcher has no session pool")
+        steps = int(steps)
+        if steps < 0:
+            raise ValueError(
+                f"submit_session: steps must be >= 0, got {steps}")
+        if not self._pool.has(str(session)):
+            raise ValueError(
+                f"submit_session: unknown session {session!r}")
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._session_queue.append((ticket, str(session), steps))
+        return ticket
+
     def bucket_keys(self) -> list[tuple]:
-        """The distinct (shape, dtype) buckets currently queued, in
-        first-submission order."""
+        """The distinct buckets currently queued, in first-submission
+        order: ``(shape, dtype)`` for board requests, ``("slab",
+        slab_id, steps)`` for resident-session steps (sessions sharing
+        a slab and step count coalesce into one in-place dispatch)."""
         seen: dict[tuple, None] = {}
         for r in self._queue:
             seen.setdefault((r.board.shape, r.board.dtype.str), None)
+        for _, sid, steps in self._session_queue:
+            h = self._pool.handle(sid)
+            slab = -1 if h is None else h.slab  # spilled: placed at flush
+            seen.setdefault(("slab", slab, steps), None)
         return list(seen)
 
     def flush(self) -> list[np.ndarray]:
@@ -200,7 +234,35 @@ class ShapeBucketBatcher:
                         padded_batch=padded, path=path,
                         tickets=tuple(r.ticket for r in chunk)))
 
-        ordered = [results[r.ticket] for r in self._queue]
+        # Resident-session steps: group by (current slab, steps) — each
+        # slab-group is ONE donated masked dispatch regardless of how
+        # few lanes are live (no BITSLICE_MIN_BATCH floor: the plane is
+        # already resident, a lone lane costs the same vector work).
+        session_tickets: list[int] = []
+        if self._session_queue:
+            groups: dict[tuple, list[tuple[int, str]]] = {}
+            for ticket, sid, steps in self._session_queue:
+                h = self._pool.handle(sid)
+                slab = -1 if h is None else h.slab
+                groups.setdefault((slab, steps), []).append((ticket, sid))
+                session_tickets.append(ticket)
+            for (slab, steps), members in groups.items():
+                sids = [sid for _, sid in members]
+                with trace.span("serve.batch", slab=slab, steps=steps,
+                                requests=len(sids), path="pool"):
+                    self._pool.step_group(sids, steps)
+                for ticket, _ in members:
+                    results[ticket] = None
+                metrics.inc("serve.requests", len(sids))
+                metrics.inc("serve.batches")
+                stats.append(_BatchStat(
+                    shape=("slab", slab), steps=steps,
+                    requests=len(sids), padded_batch=len(sids),
+                    path="pool", tickets=tuple(t for t, _ in members)))
+
+        order = sorted([r.ticket for r in self._queue] + session_tickets)
+        ordered = [results[t] for t in order]
         self._queue.clear()
+        self._session_queue.clear()
         self.last_flush_stats = stats
         return ordered

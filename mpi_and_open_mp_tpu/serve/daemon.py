@@ -85,6 +85,7 @@ class ServingDaemon:
                  wal_compact_bytes: int = 1 << 20,
                  aot_cache=None,
                  worker_index: int | None = None,
+                 pool_budget_bytes: int | None = None,
                  clock=time.monotonic, sleep=time.sleep):
         self.policy = policy or ServePolicy()
         # Fleet identity: which shard of a serve.fleet this process is.
@@ -113,6 +114,15 @@ class ServingDaemon:
             chunk_records=self.policy.max_batch,
             compact_bytes=wal_compact_bytes)
             if wal_path else None)
+        # Device-resident session pool (serve.pool.SessionPool), built
+        # lazily on the first create_session — single-shot burst daemons
+        # never pay for it. `_session_log` is the HOST mirror of the
+        # journal's view of every live session ({id, board, steps,
+        # wall}): compaction snapshots it without touching the device,
+        # and resume re-materializes into both the log and the pool.
+        self._pool = None
+        self._pool_budget = pool_budget_bytes
+        self._session_log: dict[str, dict] = {}
 
     # -- intake ------------------------------------------------------------
 
@@ -137,6 +147,158 @@ class ServingDaemon:
             self._wal.admit(t.id, t.board, t.steps, session=t.session)
         return t
 
+    # -- device-resident sessions -------------------------------------------
+
+    @property
+    def pool(self):
+        """The device-resident session pool, built on first use."""
+        if self._pool is None:
+            from mpi_and_open_mp_tpu.serve.pool import SessionPool
+
+            kw = {}
+            if self._pool_budget is not None:
+                kw["device_budget_bytes"] = self._pool_budget
+            self._pool = SessionPool(**kw)
+        return self._pool
+
+    def create_session(self, session: str, board: np.ndarray):
+        """Admit a board into the pool under ``session``. The board
+        crosses the wire exactly once, here; the CREATE frame is durable
+        before the device sees it, so kill -9 at any later instruction
+        re-materializes the session from the journal. Returns the
+        handle."""
+        session = str(session)
+        if session in self._session_log:
+            raise ValueError(
+                f"create_session: session {session!r} is already live")
+        board = np.asarray(board)
+        wall = time.time()
+        if self._wal is not None:
+            self._wal.pool_create(session, board, wall=wall)
+            if chaos.crash_armed("post-create"):
+                chaos.crash_now()
+        handle = self.pool.create(session, board)
+        self._session_log[session] = {
+            "id": session, "board": board.copy(), "steps": 0, "wall": wall}
+        return handle
+
+    def step_session(self, session: str, steps: int) -> int:
+        """Advance one resident session ``steps`` generations in place,
+        synchronously (the ticketed fast path is
+        :meth:`submit_session`). The STEP frame is write-ahead and
+        authoritative: once this method returns, the advance survives
+        any crash."""
+        return self.step_sessions([str(session)], steps)
+
+    def step_sessions(self, sessions: list[str], steps: int) -> int:
+        steps = int(steps)
+        for sid in sessions:
+            if str(sid) not in self._session_log:
+                raise ValueError(f"step_sessions: unknown session {sid!r}")
+        if self._wal is not None:
+            for sid in sessions:
+                self._wal.pool_step(str(sid), steps)
+            if chaos.crash_armed("post-step"):
+                chaos.crash_now()
+        n = self.pool.step_group([str(s) for s in sessions], steps)
+        for sid in sessions:
+            self._session_log[str(sid)]["steps"] += steps
+        return n
+
+    def submit_session(self, session: str, steps: int) -> Ticket:
+        """Admit one resident step as a ticket — the handle-sized fast
+        path. An admitted step journals exactly ONE frame (STEP, no
+        ADMIT/DISPATCH/RESOLVE triple): write-ahead and authoritative,
+        so the ack implied by this return is durable whether the
+        dispatch happens in this process or is replayed into the pool
+        on resume. Door-shed tickets never touch the journal."""
+        session = str(session)
+        if session not in self._session_log:
+            raise ValueError(f"submit_session: unknown session {session!r}")
+        t = self.queue.submit_session(
+            session, self.pool.handle(session), steps, self._clock())
+        if t.state == PENDING:
+            if self._wal is not None:
+                self._wal.pool_step(session, t.steps)
+                if chaos.crash_armed("post-step"):
+                    chaos.crash_now()
+            self._session_log[session]["steps"] += t.steps
+        return t
+
+    def snapshot_session(self, session: str) -> np.ndarray:
+        """Read a resident session's board (one device→host crossing).
+        Parity contract: the returned board is bit-identical to the
+        NumPy oracle advancing the create board by the journaled step
+        total."""
+        session = str(session)
+        if session not in self._session_log:
+            raise ValueError(
+                f"snapshot_session: unknown session {session!r}")
+        if self._wal is not None:
+            self._wal.pool_snapshot(
+                session, int(self._session_log[session]["steps"]))
+            if chaos.crash_armed("post-snapshot"):
+                chaos.crash_now()
+        return self.pool.snapshot(session)
+
+    def evict_session(self, session: str) -> np.ndarray:
+        """Remove a session from the pool, returning its final board
+        (the last wire crossing of the lifetime). The EVICT frame lands
+        first, so a crash mid-evict replays to the evicted state rather
+        than resurrecting the session."""
+        session = str(session)
+        if session not in self._session_log:
+            raise ValueError(f"evict_session: unknown session {session!r}")
+        if self._wal is not None:
+            self._wal.pool_evict(session)
+            if chaos.crash_armed("post-evict"):
+                chaos.crash_now()
+        board = self.pool.evict(session)
+        del self._session_log[session]
+        return board
+
+    def adopt_session(self, session: str, board: np.ndarray,
+                      steps: int):
+        """The destination half of a pool re-home: journal a fresh
+        CREATE + STEP lifetime on THIS worker's WAL, then let the
+        device replay the advance (``board`` is the ORIGIN's create
+        board; shipping it plus a step count moves one board across the
+        wire instead of the whole history)."""
+        session = str(session)
+        board = np.asarray(board)
+        steps = int(steps)
+        wall = time.time()
+        if self._wal is not None:
+            self._wal.pool_create(session, board, wall=wall)
+            if steps:
+                self._wal.pool_step(session, steps)
+        handle = self.pool.create(session, board)
+        if steps:
+            self.pool.step(session, steps)
+        self._session_log[session] = {
+            "id": session, "board": board.copy(), "steps": steps,
+            "wall": wall}
+        return handle
+
+    def sessions(self) -> list[str]:
+        return list(self._session_log)
+
+    def _rematerialize_pool(self, pool_sessions: dict[str, dict]) -> int:
+        """Rebuild the device pool from a WAL replay's session map:
+        every live session's create board enters the pool and advances
+        by its journaled step total (a journaled-but-unacked step is
+        applied — at-least-once on unacked work, zero acked loss)."""
+        for sid, entry in pool_sessions.items():
+            board = np.asarray(entry["board"])
+            steps = int(entry["steps"])
+            self.pool.create(sid, board)
+            if steps:
+                self.pool.step(sid, steps)
+            self._session_log[sid] = {
+                "id": sid, "board": board.copy(), "steps": steps,
+                "wall": float(entry.get("wall", 0.0))}
+        return len(pool_sessions)
+
     # -- fleet worker-mode hooks -------------------------------------------
 
     def release(self, tickets: list[Ticket],
@@ -149,9 +311,13 @@ class ServingDaemon:
         elsewhere) and comes back as a portable entry ``{board, steps,
         session, queued_s, wall}`` for :meth:`adopt` on the destination.
         Non-pending tickets are skipped — a result that already resolved
-        must not be recomputed under a new id."""
+        must not be recomputed under a new id. Resident session tickets
+        are skipped too: their STEP frames are already journaled and
+        authoritative, so a pool re-home moves the SESSION (create board
+        + step total, via :meth:`adopt_session`), never step tickets."""
         now = self._clock() if now is None else now
-        live = [t for t in tickets if t.state == PENDING]
+        live = [t for t in tickets
+                if t.state == PENDING and t.board is not None]
         wall = time.time()
         entries = [
             {"board": np.asarray(t.board), "steps": t.steps,
@@ -271,6 +437,11 @@ class ServingDaemon:
                     daemon.queue.restore_ticket(
                         entry["board"], entry["steps"], now, queued_s=queued,
                         session=entry.get("session"))
+                # Re-materialize the device pool BEFORE rotating the
+                # journal: rotation snapshots the session log, so the
+                # log must already hold every replayed session.
+                if rep.pool_sessions:
+                    daemon._rematerialize_pool(rep.pool_sessions)
                 daemon._compact_wal()
                 detail["wal_replay"] = rep.counts()
                 trace.event("serve.resume", source="wal",
@@ -309,7 +480,7 @@ class ServingDaemon:
         if self._aot is None:
             return None
         boards = {(t.board.shape, str(np.asarray(t.board).dtype))
-                  for t in self.queue.pending()}
+                  for t in self.queue.pending() if t.board is not None}
         if not boards:
             return None
         summary = self._aot.warm(sorted(boards), self.policy.max_batch)
@@ -354,6 +525,11 @@ class ServingDaemon:
             n += 1
         if self._wal is not None and self._wal.should_compact():
             self._compact_wal()
+        if self._pool is not None:
+            # Background lane hygiene: repack sparse planes left by dead
+            # sessions while the queue is quiet — the device pays a
+            # 32-at-a-time pack/unpack, never a per-lane shuffle.
+            self._pool.maybe_compact()
         return n
 
     def drain(self) -> None:
@@ -376,9 +552,9 @@ class ServingDaemon:
             {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
              "wall": wall, "session": t.session,
              "queued_s": t.queued_before_s + (now - t.submitted_at)}
-            for t in self.queue.pending()
+            for t in self.queue.pending() if t.board is not None
         ]
-        self._wal.compact(entries)
+        self._wal.compact(entries, pool_sessions=self._session_log)
 
     def _shed_batch(self, tickets: list[Ticket], reason: str,
                     now: float) -> None:
@@ -516,8 +692,34 @@ class ServingDaemon:
         rungs += [("batch:xla", xla), ("oracle", oracle)]
         return rungs
 
+    def _dispatch_pool_chunk(self, chunk: list[Ticket]) -> None:
+        """Resolve one slab-group of resident step tickets with a single
+        in-place pool dispatch. No WAL frames here — each ticket's STEP
+        frame was journaled (authoritative) at submit, so a death at any
+        point in this method replays the advance into the pool on
+        resume; no timeout shed either, for the same reason (the step is
+        already promised durable, so it must happen exactly once)."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        sids = [t.session for t in chunk]
+        steps = chunk[0].steps
+        with trace.span("serve.dispatch.pool", requests=len(chunk),
+                        steps=steps):
+            self.pool.step_group(sids, steps)
+        now = self._clock()
+        for t in chunk:
+            self.queue.resolve(t, None, "pool:bitsliced", now)
+        if self._first_result_s is None:
+            self._first_result_s = now - self._created_at
+        self._batches += 1
+        metrics.inc("serve.batches")
+
     def _dispatch_chunk(self, chunk: list[Ticket]) -> None:
         from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        if chunk and chunk[0].handle is not None:
+            self._dispatch_pool_chunk(chunk)
+            return
 
         p = self.policy
         now = self._clock()
@@ -652,6 +854,17 @@ class ServingDaemon:
         }
         if self._first_result_s is not None:
             out["cold_first_result_s"] = round(self._first_result_s, 6)
+        if self._pool is not None:
+            s = self._pool.stats()
+            out["pool"] = s
+            # Flat copies of the fields the bench line and the
+            # regression sentinel watch.
+            out["pool_sessions"] = s["sessions"]
+            out["pool_hits"] = s["hits"]
+            out["pool_misses"] = s["misses"]
+            out["pool_evictions"] = s["evictions"]
+            out["pool_spills"] = s["spills"]
+            out["pool_compactions"] = s["compactions"]
         if self._wal is not None:
             out["wal"] = self._wal.stats()
         if self._aot is not None:

@@ -117,6 +117,20 @@ class Fleet:
         return self.router.submit(board, steps, self._clock(),
                                   session=session)
 
+    def create_session(self, session: str, board):
+        """Admit a resident session into its affinity worker's device
+        pool (the ring is the session→pool map)."""
+        return self.router.create_session(session, board, self._clock())
+
+    def step_session(self, session: str, steps: int) -> Ticket:
+        return self.router.step_session(session, steps, self._clock())
+
+    def snapshot_session(self, session: str):
+        return self.router.snapshot_session(session)
+
+    def evict_session(self, session: str):
+        return self.router.evict_session(session)
+
     def wedge(self, index: int) -> None:
         """Simulate a wedged worker: stop pumping it. Its heartbeat
         goes stale and the ROUTER must notice (``check_health``) —

@@ -39,7 +39,10 @@ class Ticket:
     """One request's life, admission through terminal state."""
 
     id: int
-    board: np.ndarray
+    #: The payload for ship-every-ticket requests; ``None`` for a
+    #: resident session step — the board never leaves the device, the
+    #: ticket carries only the pool handle.
+    board: np.ndarray | None
     steps: int
     submitted_at: float
     state: str = PENDING
@@ -58,9 +61,17 @@ class Ticket:
     #: this carry a resumed ticket's latency would silently forget its
     #: pre-crash queue time and post-resume p99 would flatter the tail.
     queued_before_s: float = 0.0
+    #: Device-resident handle (``serve.pool.Handle``) for a session step
+    #: ticket. Set iff ``board`` is ``None``.
+    handle: object | None = None
 
     @property
     def bucket_key(self) -> tuple:
+        if self.handle is not None:
+            # Resident steps bucket by slab: every lane of a slab is
+            # advanced by the SAME donated dispatch, so slab-mates with
+            # equal step counts coalesce into one program invocation.
+            return ("pool", self.handle.slab, self.steps)
         return (self.board.shape, self.board.dtype.str, self.steps)
 
     @property
@@ -105,7 +116,8 @@ class ServeQueue:
         counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
         reason = policy_mod.admit(
             self.policy, self.depth(),
-            [(n, self._slice_width(key)) for key, n in counts.items()])
+            [(n, self._slice_width(key)) for key, n in counts.items()
+             if key[0] != "pool"])
         self._tickets[t.id] = t
         metrics.inc("serve.requests")
         if reason is not None:
@@ -115,6 +127,34 @@ class ServeQueue:
             trace.event("serve.admit", ticket=t.id,
                         shape=f"{board.shape[0]}x{board.shape[1]}",
                         steps=steps)
+        return t
+
+    def submit_session(self, session: str, handle, steps: int,
+                       now: float) -> Ticket:
+        """Admit or reject one resident session step. The padding-waste
+        gate does not apply — a pool dispatch advances whole planes in
+        place, so a partly-live slab costs exactly what a full one does
+        and there is no dead-padding denominator to project. Depth still
+        gates (pending handles queue host bookkeeping and dispatch
+        latency like any ticket)."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        steps = int(steps)
+        if steps < 0:
+            raise ValueError(
+                f"submit_session: steps must be >= 0, got {steps}")
+        t = Ticket(self._next_ticket, None, steps, float(now),
+                   session=str(session), handle=handle)
+        self._next_ticket += 1
+        metrics.inc("serve.requests")
+        if self.depth() >= self.policy.max_depth:
+            self._tickets[t.id] = t
+            self._shed(t, policy_mod.SHED_DEPTH, now)
+            return t
+        self._tickets[t.id] = t
+        metrics.inc("serve.admitted")
+        trace.event("serve.admit", ticket=t.id, session=str(session),
+                    steps=steps, resident=True)
         return t
 
     def restore_ticket(self, board: np.ndarray, steps: int,
@@ -192,9 +232,12 @@ class ServeQueue:
         ticket has waited ``max_wait_s`` (or everything when draining).
         Chunks come out in oldest-ticket-first order so a starved bucket
         is served before a fresh full one."""
-        mb = self.policy.max_batch
         chunks: list[list[Ticket]] = []
-        for _, group in self.buckets().items():
+        for key, group in self.buckets().items():
+            # A pool bucket's natural chunk is the slab's lane count:
+            # one donated dispatch advances every lane of one plane, so
+            # there is no reason to split below — or batch above — 32.
+            mb = 32 if key[0] == "pool" else self.policy.max_batch
             due = drain or (now - group[0].submitted_at
                             >= self.policy.max_wait_s)
             lo = 0
@@ -251,7 +294,10 @@ class ServeQueue:
         resumed ticket back to the pre-preemption submission), and each
         ticket's cumulative queued seconds as of ``now`` (pass the
         drain clock so a resumed ticket's latency keeps counting from
-        its FIRST submission, not the restore)."""
+        its FIRST submission, not the restore). Resident session
+        tickets (``board is None``) are EXCLUDED: their durable state is
+        the WAL's handle-lifecycle frames, not the queue — restoring
+        one here would double-apply its step on resume."""
         return {
             "schema": STATE_SCHEMA,
             "next_ticket": self._next_ticket,
@@ -261,7 +307,7 @@ class ServeQueue:
                  "queued_s": (t.queued_before_s
                               + (float(now) - t.submitted_at
                                  if now is not None else 0.0))}
-                for t in self.pending()
+                for t in self.pending() if t.board is not None
             ],
         }
 

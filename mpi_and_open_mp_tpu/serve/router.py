@@ -182,6 +182,7 @@ class FleetRouter:
         self.door_shed: dict[str, int] = {}
         self.submitted = 0
         self.rehomes = 0  # re-home MOVES (one ticket moved twice = 2)
+        self.pool_rehomed = 0  # resident sessions moved off wedged workers
         self.steals = 0
         self.wedged_workers: list[int] = []
         #: Tickets adopted during the most recent wedge re-home — the
@@ -201,6 +202,25 @@ class FleetRouter:
         live = self.live_workers()
         if live:
             self._rollup = policy_mod.rollup(w.daemon.policy for w in live)
+
+    def add_worker(self, worker) -> None:
+        """Admit a worker to the fleet mid-burst: into the worker table,
+        onto the ring (bounded movement — only sessions landing on the
+        new worker's points move), and — the part that used to be
+        missed — into the admission projection: the door's rolled-up
+        depth budget must widen the moment capacity joins, exactly as it
+        narrows on a wedge, or the fleet sheds against yesterday's
+        fleet size."""
+        from mpi_and_open_mp_tpu.obs import trace
+
+        index = int(worker.index)
+        if index in self._workers:
+            raise ValueError(f"worker index {index} already in the fleet")
+        self._workers[index] = worker
+        self.ring.add_worker(index)
+        self._recompute_rollup()
+        trace.event("serve.fleet.join", worker=index,
+                    live=len(self.live_workers()))
 
     # -- routing + global admission ----------------------------------------
 
@@ -247,6 +267,31 @@ class FleetRouter:
             self._rollup, depth,
             [(n, widths[key]) for key, n in counts.items()])
 
+    # -- device-resident sessions ------------------------------------------
+    #
+    # The consistent-hash ring IS the session→worker pool map: a
+    # session's boards live in exactly one worker's device pool, the one
+    # its key hashes to. These methods route the four lifecycle verbs;
+    # a wedge re-homes the sessions themselves (create board + journaled
+    # step total — one board crosses the wire, the destination's device
+    # replays the advance).
+
+    def create_session(self, session: str, board, now: float):
+        return self._workers[self.ring.lookup(str(session))].daemon \
+            .create_session(session, board)
+
+    def step_session(self, session: str, steps: int, now: float) -> Ticket:
+        return self._workers[self.ring.lookup(str(session))].daemon \
+            .submit_session(session, steps)
+
+    def snapshot_session(self, session: str):
+        return self._workers[self.ring.lookup(str(session))].daemon \
+            .snapshot_session(session)
+
+    def evict_session(self, session: str):
+        return self._workers[self.ring.lookup(str(session))].daemon \
+            .evict_session(session)
+
     # -- failure isolation -------------------------------------------------
 
     def check_health(self, now: float) -> list[int]:
@@ -283,7 +328,7 @@ class FleetRouter:
         self.wedged_workers.append(index)
         self._recompute_rollup()
 
-        entries = self._drain_victim(victim, now)
+        entries, pool_sessions = self._drain_victim(victim, now)
         adopted: list[Ticket] = []
         by_target: dict[int, list[dict]] = {}
         for e in entries:
@@ -292,27 +337,50 @@ class FleetRouter:
         for tgt_index, group in by_target.items():
             adopted.extend(
                 self._workers[tgt_index].daemon.adopt(group, now))
+        # Re-home the victim's RESIDENT SESSIONS: the ring minus the
+        # victim names each session's new pool, and adopt_session
+        # journals a fresh CREATE+STEP lifetime there before the
+        # destination device replays the advance — the re-home carries
+        # a snapshot-equivalent (create board + step total), never the
+        # raw slab.
+        for sid, entry in pool_sessions.items():
+            tgt = self._workers[self.ring.lookup(str(sid))]
+            tgt.daemon.adopt_session(sid, entry["board"],
+                                     int(entry["steps"]))
+            # Close the victim's books: an EVICT frame per moved session
+            # (the pool twin of the re-homed SHED) makes a second replay
+            # of the victim's journal find nothing live.
+            if victim.daemon._wal is not None:
+                victim.daemon._wal.pool_evict(sid)
+            victim.daemon._session_log.pop(sid, None)
+            self.pool_rehomed += 1
         self.rehomes += len(entries)
         self.last_rehomed = adopted
         metrics.inc("serve.fleet.wedged")
         metrics.inc("serve.fleet.rehomed", len(entries))
+        if pool_sessions:
+            metrics.inc("serve.fleet.pool_rehomed", len(pool_sessions))
         trace.event("serve.fleet.wedged", worker=index,
-                    rehomed=len(entries),
+                    rehomed=len(entries), pool=len(pool_sessions),
                     survivors=len(survivors))
         return adopted
 
-    def _drain_victim(self, victim, now: float) -> list[dict]:
+    def _drain_victim(self, victim, now: float) -> tuple[list[dict], dict]:
         """The victim's outstanding entries, from its journal when it
         has one (a wedged process's memory is not trustworthy; its WAL
         is), else from the live queue. Either way the victim's own books
         close: every drained ticket sheds ``re-homed`` in its queue and
         — via :meth:`ServingDaemon.release` — in its journal, so a
-        second replay finds nothing pending."""
+        second replay finds nothing pending. Returns ``(entries,
+        pool_sessions)``: the second element is the victim's live
+        resident-session map (WAL-replayed ``{sid: {board, steps,
+        wall}}``; the in-memory session log when there is no journal)."""
         from mpi_and_open_mp_tpu.serve import wal as wal_mod
 
         pending = victim.daemon.queue.pending()
         if victim.wal_path is None:
-            return victim.daemon.release(pending, now)
+            return (victim.daemon.release(pending, now),
+                    dict(victim.daemon._session_log))
         rep = wal_mod.replay(victim.wal_path)
         # Close the in-memory books with the same re-homed sheds (this
         # also appends the SHED frames that make the journal replay
@@ -327,7 +395,7 @@ class FleetRouter:
                 "session": e.get("session"), "wall": e.get("wall", 0.0),
                 "queued_s": e.get("queued_s", 0.0),
             })
-        return entries
+        return entries, rep.pool_sessions
 
     # -- work stealing -----------------------------------------------------
 

@@ -46,6 +46,34 @@ Record types and what :func:`replay` does with them:
     Head frame of a rotated journal: the full pending set lives in the
     crash-atomic ``save_state`` snapshot at ``<path>.snap.<generation>``
     and the frames after this one are the tail written since rotation.
+    Pool sessions rotate with it: the snapshot's ``pool`` list carries
+    ``{id, board, steps, wall}`` per live session (the create board
+    plus the *total* journaled step count), so a rotated journal
+    re-materializes the pool exactly as a never-rotated one would.
+
+Handle-lifecycle records (the device-resident session pool, PR 12).
+These journal *state transitions of resident sessions* rather than
+tickets — resident step traffic writes exactly one frame per request
+(no ADMIT/DISPATCH/RESOLVE triple), which is what makes the WAL cheap
+enough to sit on the handle fast path:
+
+``CREATE {id, board, steps:0, wall}``
+    A session entered the pool with this board. The board crosses the
+    wire (and the journal) exactly once, here. Re-creating an id that
+    is live is an inconsistency error; re-creating after an ``EVICT``
+    is a legitimate new lifetime.
+``STEP {id, steps}``
+    The session advanced ``steps`` generations in place. Write-ahead
+    and *authoritative*: resume state is the create board advanced by
+    the sum of journaled steps, so a journaled-but-unacked step is
+    applied on resume (at-least-once on unacked work, zero acked loss
+    — the ack only returns after the frame is durable).
+``SNAPSHOT {id, steps_applied}``
+    The caller read the session's board. Nothing to replay — the frame
+    exists so the crash matrix can kill between a snapshot and the
+    next transition and prove the books still balance.
+``EVICT {id}``
+    The session left the pool (terminal for this lifetime).
 
 **Torn-tail tolerance.** A crash mid-append (SIGKILL between the two
 ``write``s, a filled disk, the injected ``crash=mid-frame:<k>`` chaos
@@ -119,7 +147,8 @@ FSYNC_POLICIES = ("every-record", "every-chunk", "off")
 #: Record types whose append closes a chunk lifecycle step — the
 #: ``every-chunk`` policy syncs on these (and on a full buffer) so a
 #: dispatched batch is never less durable than its admits.
-_CHUNK_BOUNDARY = ("DISPATCH", "RESOLVE", "SHED", "COMPACT")
+_CHUNK_BOUNDARY = ("DISPATCH", "RESOLVE", "SHED", "COMPACT",
+                   "CREATE", "STEP", "EVICT")
 
 
 def _snap_path(path: str, generation: int) -> str:
@@ -135,14 +164,18 @@ class WALReplay:
     including the ``in_flight_ids`` of an open ``DISPATCH`` (redispatch
     is idempotent, so they simply rejoin the queue). ``resolved_ids`` /
     ``shed_ids`` close the books: every id the dead process journaled
-    terminal. ``truncated_at`` is the byte offset of a torn tail
-    (``None`` for a clean EOF).
+    terminal. ``pool_sessions`` maps live session id → ``{id, board,
+    steps, wall}`` — the create board plus the summed journaled step
+    count, which *is* the session's resumable state (re-materialize by
+    advancing ``board`` ``steps`` generations). ``truncated_at`` is the
+    byte offset of a torn tail (``None`` for a clean EOF).
     """
 
     pending: list[dict]
     in_flight_ids: set[int]
     resolved_ids: set[int]
     shed_ids: set[int]
+    pool_sessions: dict[str, dict] = dataclasses.field(default_factory=dict)
     generation: int = 0
     frames: int = 0
     truncated_at: int | None = None
@@ -158,6 +191,7 @@ class WALReplay:
             "in_flight": len(self.in_flight_ids),
             "resolved": len(self.resolved_ids),
             "shed": len(self.shed_ids),
+            "pool_sessions": len(self.pool_sessions),
             "generation": self.generation,
             "frames": self.frames,
             "truncated": self.truncated,
@@ -245,6 +279,41 @@ def replay(path: str | os.PathLike) -> WALReplay:
                 pending.pop(int(tid), None)
                 rep.in_flight_ids.discard(int(tid))
                 rep.shed_ids.add(int(tid))
+        elif rtype == "CREATE":
+            sid = str(rec["id"])
+            if sid in rep.pool_sessions:
+                raise ValueError(
+                    f"ticket journal at {path} re-creates live pool "
+                    f"session {sid!r} at frame {rep.frames} — the "
+                    "journal is internally inconsistent")
+            rep.pool_sessions[sid] = {
+                "id": sid, "board": np.asarray(rec["board"]),
+                "steps": int(rec.get("steps", 0)),
+                "wall": float(rec.get("wall", 0.0)),
+            }
+        elif rtype == "STEP":
+            sid = str(rec["id"])
+            if sid not in rep.pool_sessions:
+                raise ValueError(
+                    f"ticket journal at {path} steps unknown pool "
+                    f"session {sid!r} at frame {rep.frames}")
+            rep.pool_sessions[sid]["steps"] += int(rec["steps"])
+        elif rtype == "SNAPSHOT":
+            sid = str(rec["id"])
+            if sid not in rep.pool_sessions:
+                raise ValueError(
+                    f"ticket journal at {path} snapshots unknown pool "
+                    f"session {sid!r} at frame {rep.frames}")
+            # Nothing to mutate: a snapshot is a read. The frame exists
+            # so the crash matrix can land between it and the next
+            # transition and prove the replayed state is unaffected.
+        elif rtype == "EVICT":
+            sid = str(rec["id"])
+            if sid not in rep.pool_sessions:
+                raise ValueError(
+                    f"ticket journal at {path} evicts unknown pool "
+                    f"session {sid!r} at frame {rep.frames}")
+            del rep.pool_sessions[sid]
         elif rtype == "COMPACT":
             if rep.frames != 0:
                 raise ValueError(
@@ -275,6 +344,13 @@ def replay(path: str | os.PathLike) -> WALReplay:
                     "wall": float(entry.get("wall", 0.0)),
                     "queued_s": float(entry.get("queued_s", 0.0)),
                     "session": entry.get("session"),
+                }
+            for entry in snap.get("pool", []):
+                sid = str(entry["id"])
+                rep.pool_sessions[sid] = {
+                    "id": sid, "board": np.asarray(entry["board"]),
+                    "steps": int(entry["steps"]),
+                    "wall": float(entry.get("wall", 0.0)),
                 }
         else:
             raise ValueError(
@@ -359,17 +435,41 @@ class TicketWAL:
         self._append("SHED", {"ids": [int(i) for i in ticket_ids],
                               "reason": str(reason)})
 
+    # -- pool handle-lifecycle appends --------------------------------------
+
+    def pool_create(self, session: str, board, *,
+                    wall: float | None = None) -> None:
+        self._append("CREATE", {
+            "id": str(session), "board": np.asarray(board), "steps": 0,
+            "wall": time.time() if wall is None else float(wall),
+        })
+
+    def pool_step(self, session: str, steps: int) -> None:
+        self._append("STEP", {"id": str(session), "steps": int(steps)})
+
+    def pool_snapshot(self, session: str, steps_applied: int) -> None:
+        self._append("SNAPSHOT", {"id": str(session),
+                                  "steps_applied": int(steps_applied)})
+
+    def pool_evict(self, session: str) -> None:
+        self._append("EVICT", {"id": str(session)})
+
     # -- compaction --------------------------------------------------------
 
     def should_compact(self) -> bool:
         return self._bytes_since_compact >= self.compact_bytes
 
-    def compact(self, pending_entries: list[dict]) -> None:
+    def compact(self, pending_entries: list[dict],
+                pool_sessions: dict[str, dict] | None = None) -> None:
         """Rotate the journal: pending set to a crash-atomic snapshot,
         journal file atomically replaced by a COMPACT-headed fresh one.
         ``pending_entries`` are ``{id, board, steps, wall, queued_s}``
         dicts in admit order (the daemon computes ``queued_s`` against
-        its own clock at rotation time)."""
+        its own clock at rotation time). ``pool_sessions`` maps live
+        session id → ``{id, board, steps, wall}`` — the create board
+        plus total journaled steps, i.e. the same resumable shape
+        ``replay`` reconstructs, so the rotation never touches the
+        device (no snapshot reads at compact time)."""
         from mpi_and_open_mp_tpu.obs import metrics, trace
 
         gen = self._generation + 1
@@ -379,11 +479,15 @@ class TicketWAL:
             "queued_s": float(e.get("queued_s", 0.0)),
             "session": e.get("session"),
         } for e in pending_entries]
+        pool = [{
+            "id": str(s["id"]), "board": np.asarray(s["board"]),
+            "steps": int(s["steps"]), "wall": float(s.get("wall", 0.0)),
+        } for s in (pool_sessions or {}).values()]
         with trace.span("serve.wal.compact", generation=gen,
-                        pending=len(entries)):
+                        pending=len(entries), pool=len(pool)):
             checkpoint_mod.save_state(_snap_path(self.path, gen), {
                 "schema": WAL_SNAP_SCHEMA, "generation": gen,
-                "pending": entries,
+                "pending": entries, "pool": pool,
             })
             head = WAL_MAGIC + _encode(
                 "COMPACT", {"generation": gen, "count": len(entries)})

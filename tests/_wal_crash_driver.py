@@ -8,7 +8,18 @@ chaos site hard-kills the process with ``os._exit(137)`` — no atexit, no
 finally — and the parent then replays the journal and asserts the
 per-fsync-policy loss bound over exactly the acked set.
 
-Usage: ``python _wal_crash_driver.py WAL_PATH FSYNC_POLICY ACK_PATH N``
+Usage: ``python _wal_crash_driver.py WAL_PATH FSYNC_POLICY ACK_PATH N [pool]``
+
+With the optional ``pool`` mode the driver exercises the resident-
+session handle lifecycle instead of the ticket path: create N pool
+sessions (ack ``C <sid>`` once create returns), two rounds of 2-step
+resident steps per session (ack ``S <sid> 2``), one snapshot (``N
+<sid>``), one evict (``E <sid>``). The pool chaos sites
+(``post-create``/``post-step``/``post-snapshot``/``post-evict``) fire
+AFTER the frame is journaled and BEFORE the pool acts, so an acked op is
+always durable under ``every-record`` and the parent can assert the
+resumed pool matches the acked ledger exactly (plus at most one
+journaled-but-unacked op — the at-least-once edge).
 
 Exits 0 after a clean drain (printing a one-line JSON summary); a
 planned crash never reaches that code.
@@ -35,16 +46,39 @@ def main() -> int:
 
     wal_path, fsync, ack_path = sys.argv[1], sys.argv[2], sys.argv[3]
     n = int(sys.argv[4])
+    pool_mode = len(sys.argv) > 5 and sys.argv[5] == "pool"
     policy = ServePolicy(max_batch=4, max_wait_s=0.0)
     daemon = ServingDaemon(policy, wal_path=wal_path, wal_fsync=fsync)
     rng = np.random.default_rng(7)
     with open(ack_path, "ab") as ack:
+        def rec(line: str) -> None:
+            ack.write((line + "\n").encode())
+            ack.flush()
+            os.fsync(ack.fileno())
+
+        if pool_mode:
+            for i in range(n):
+                board = (rng.random((12, 12)) < 0.3).astype(np.uint8)
+                daemon.create_session(f"p{i}", board)
+                rec(f"C p{i}")
+            for _ in range(2):
+                for i in range(n):
+                    daemon.step_session(f"p{i}", 2)
+                    rec(f"S p{i} 2")
+            daemon.snapshot_session("p0")
+            rec("N p0")
+            daemon.evict_session(f"p{n - 1}")
+            rec(f"E p{n - 1}")
+            daemon._wal.sync()
+            s = daemon.summary()
+            daemon._wal.close()
+            print(json.dumps({"sessions": s["pool_sessions"]}))
+            return 0
+
         for i in range(n):
             board = (rng.random((12, 12)) < 0.3).astype(np.uint8)
             t = daemon.submit(board, 2)
-            ack.write(f"{t.id}\n".encode())
-            ack.flush()
-            os.fsync(ack.fileno())
+            rec(str(t.id))
     daemon.serve()
     s = daemon.summary()
     daemon._wal.close()

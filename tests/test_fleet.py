@@ -365,3 +365,83 @@ def test_sentinel_polarity_for_fleet_fields():
     assert rs.direction_for("fleet_requests_per_sec") == "higher"
     assert rs.direction_for("fleet_p99_latency_s") == "lower"
     assert rs.direction_for("fleet_kill_recovery_s") == "lower"
+
+
+# ------------------------------------------------- live join + pool re-home
+
+
+def test_add_worker_rerolls_admission_live(make_board):
+    """Regression (the satellite's target): joining a worker mid-burst
+    must widen the router door's rolled-up depth budget IMMEDIATELY —
+    before the fix, the rollup was computed once at construction, so a
+    grown fleet kept shedding at yesterday's capacity."""
+    from mpi_and_open_mp_tpu.serve import ServingDaemon, WorkerHandle
+
+    pol = ServePolicy(max_batch=4, max_depth=2, max_wait_s=100.0)
+    f, clk = _fleet(2, pol, steal=False)
+    b = make_board(16, 16)
+    # Fill the 2-worker rolled depth (2+2) exactly.
+    admitted = 0
+    i = 0
+    while admitted < 4:
+        t = f.submit(b, 2, session=f"fill-{i}")
+        admitted += t.state == PENDING
+        i += 1
+    t = f.submit(b, 2, session="overflow")
+    assert t.state == SHED and t.id < 0  # the ROUTER door, pre-worker
+    door_shed_before = f.router.door_shed.get(policy_mod.SHED_DEPTH)
+
+    d = ServingDaemon(pol, worker_index=2, clock=clk, sleep=clk.sleep)
+    h = WorkerHandle(index=2, daemon=d, last_beat=clk())
+    f.router.add_worker(h)
+    f.handles.append(h)
+    # The door's budget is now 6: capacity that joined admits at once.
+    sess = _session_for(f, 2)  # lands on the new worker: no local cap
+    assert f.submit(b, 2, session=sess).state == PENDING
+    assert f.router.door_shed.get(policy_mod.SHED_DEPTH) == door_shed_before
+    with pytest.raises(ValueError, match="already in the fleet"):
+        f.router.add_worker(h)
+    f.serve_until_drained()
+    assert f.summary()["balanced"]
+
+
+def test_fleet_wedge_rehomes_pool_sessions(tmp_path, make_board):
+    """A wedged worker's RESIDENT sessions survive it: the router
+    replays the victim's journal, adopts each session at its new ring
+    home (one board crosses the wire; the destination device replays
+    the advance), closes the victim's books with EVICT frames, and
+    every re-homed snapshot stays bit-identical to the oracle."""
+    pol = ServePolicy(max_batch=4, max_wait_s=0.0)
+    f, clk = _fleet(3, pol, wal_dir=str(tmp_path), steal=False,
+                    heartbeat_interval_s=0.02)
+    boards = {f"sess-{i}": make_board(16, 16) for i in range(12)}
+    for sid, b in boards.items():
+        f.create_session(sid, b)
+    tickets = [f.step_session(sid, 2) for sid in boards]
+    f.serve_until_drained()
+    assert all(t.state == DONE for t in tickets)
+
+    victim = f.router.target_for("sess-0")
+    moved = [sid for sid in boards if f.router.target_for(sid) == victim]
+    f.wedge(victim)
+    for _ in range(6):
+        f.pump()
+        clk.sleep(0.02)
+    assert f.handles[victim].wedged
+    assert f.router.pool_rehomed == len(moved)
+    for sid, b in boards.items():
+        assert f.router.target_for(sid) != victim
+        np.testing.assert_array_equal(
+            f.snapshot_session(sid), oracle_n(b, 2),
+            err_msg=f"session {sid} lost parity across the re-home")
+    # The victim's journal closed its books: a second replay finds no
+    # resident sessions (EVICT framed per adoption), so a recovery
+    # worker can never double-adopt.
+    rep = wal_mod.replay(str(tmp_path / f"worker{victim}.wal"))
+    assert rep.pool_sessions == {}
+    # Life goes on at the new homes.
+    t = f.step_session("sess-0", 3)
+    f.serve_until_drained()
+    assert t.state == DONE
+    np.testing.assert_array_equal(
+        f.snapshot_session("sess-0"), oracle_n(boards["sess-0"], 5))

@@ -1,0 +1,434 @@
+"""Device-resident session pool: handles, slabs, residency, durability.
+
+The contracts under test: a session's board round-trips bit-exact
+through its (slab, bit-lane) handle; stepping any lane subset of a slab
+is ONE donated dispatch sharing ONE compiled program (``jit.retrace
+{fn=pool_step}``) with full-slab steps; lanes are isolated (stepping one
+never perturbs slab-mates); compaction migrates survivors into dense
+slabs and frees the donors without changing any step result; the LRU
+spill tier keeps every session correct under a hard device budget; the
+WAL handle-lifecycle records (CREATE/STEP/SNAPSHOT/EVICT, STEP
+write-ahead and authoritative) survive compaction rotation and a real
+SIGKILL at every pool chaos site, with resume re-materializing the pool
+bit-identical to the NumPy oracle replay; and the batcher coalesces
+below-``BITSLICE_MIN_BATCH`` session steps into slab-group dispatches.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import oracle_n
+from mpi_and_open_mp_tpu.obs import metrics
+from mpi_and_open_mp_tpu.robust import chaos
+from mpi_and_open_mp_tpu.serve import (
+    Handle,
+    PoolError,
+    ServePolicy,
+    ServingDaemon,
+    SessionPool,
+    ShapeBucketBatcher,
+)
+from mpi_and_open_mp_tpu.serve import wal
+from mpi_and_open_mp_tpu.serve.queue import DONE, PENDING, SHED
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_wal_crash_driver.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _board(rng, n=16):
+    return (rng.random((n, n)) < 0.35).astype(np.uint8)
+
+
+# ------------------------------------------------------------------ handles
+
+
+def test_create_snapshot_roundtrip_and_errors(rng):
+    pool = SessionPool()
+    boards = {f"s{i}": _board(rng) for i in range(5)}
+    for sid, b in boards.items():
+        h = pool.create(sid, b)
+        assert isinstance(h, Handle) and 0 <= h.lane < 32
+    for sid, b in boards.items():
+        np.testing.assert_array_equal(pool.snapshot(sid), b)
+    # Five same-shape sessions pack into ONE slab (dense lanes).
+    assert pool.stats()["slabs"] == 1
+    with pytest.raises(PoolError, match="exists"):
+        pool.create("s0", boards["s0"])
+    with pytest.raises(PoolError, match="unknown"):
+        pool.step("nope", 1)
+    with pytest.raises(PoolError, match="unknown"):
+        pool.snapshot("nope")
+    # Re-create after evict is legal (the WAL replay relies on it).
+    pool.evict("s0")
+    pool.create("s0", boards["s0"])
+    np.testing.assert_array_equal(pool.snapshot("s0"), boards["s0"])
+
+
+def test_step_group_parity_and_lane_isolation(rng):
+    pool = SessionPool()
+    boards = {f"s{i:02d}": _board(rng) for i in range(40)}
+    for sid, b in boards.items():
+        pool.create(sid, b)
+    # 40 sessions = 2 slabs = 2 dispatches for the whole group.
+    assert pool.step_group(list(boards), 3) == 2
+    for sid, b in boards.items():
+        np.testing.assert_array_equal(pool.snapshot(sid), oracle_n(b, 3))
+    # Lane isolation: stepping ONE lane leaves its 31 slab-mates' bits
+    # untouched (the masked in-place write is the hazard under test).
+    pool.step("s00", 5)
+    np.testing.assert_array_equal(
+        pool.snapshot("s00"), oracle_n(boards["s00"], 8))
+    for sid in list(boards)[1:]:
+        np.testing.assert_array_equal(
+            pool.snapshot(sid), oracle_n(boards[sid], 3))
+
+
+def test_lone_and_group_steps_share_one_compiled_program(rng):
+    metrics.reset()
+    pool = SessionPool()
+    # A shape no other test uses: the jit cache is process-wide, so a
+    # shared shape would have been traced (and ticked) before reset.
+    for i in range(33):  # two slabs, second nearly empty
+        pool.create(f"s{i:02d}", _board(rng, 24))
+    pool.step_group([f"s{i:02d}" for i in range(33)], 2)
+    pool.step("s00", 1)           # lone lane
+    pool.step_group(["s05", "s09", "s32"], 4)  # cross-slab subset
+    # Mask and step count are runtime data: every dispatch above — full
+    # slab, lone lane, sparse subset — is the SAME compiled program.
+    assert metrics.get("jit.retrace", fn="pool_step") == 1
+
+
+# --------------------------------------------------------------- compaction
+
+
+def test_compaction_drill_evict_31_of_32(rng):
+    """The ISSUE's drill: two slabs, evict 31 of the first slab's 32
+    lanes — compaction must migrate the survivor into the other slab,
+    free the donor, surface it in stats and gauges, and change no step
+    result."""
+    metrics.reset()
+    pool = SessionPool()
+    boards = {f"s{i:02d}": _board(rng) for i in range(40)}
+    for sid, b in boards.items():
+        pool.create(sid, b)
+    pool.step_group(list(boards), 2)
+    assert pool.stats()["slabs"] == 2
+    slab0 = [sid for sid in boards if pool.handle(sid).slab == 0]
+    assert len(slab0) == 32
+    survivor = slab0[0]
+    for sid in slab0[1:]:
+        pool.evict(sid)
+    before = pool.snapshot(survivor)
+    assert pool.fragmented_shapes() == [(16, 16)]
+
+    res = pool.maybe_compact()
+    assert res is not None and res["migrated"] >= 1
+    assert res["slabs_freed"] >= 1
+    assert pool.stats()["slabs"] == 1
+    assert pool.fragmented_shapes() == []
+    assert pool.handle(survivor).slab != 0 or True  # re-pointed handle
+    gauges = metrics.snapshot()["gauges"]
+    assert gauges["pool.slabs"] == 1
+    assert gauges["pool.lanes_live"] == 9  # 8 from slab 1 + survivor
+    # Migration is invisible to the session: same board, same future.
+    np.testing.assert_array_equal(pool.snapshot(survivor), before)
+    pool.step(survivor, 2)
+    np.testing.assert_array_equal(
+        pool.snapshot(survivor), oracle_n(boards[survivor], 4))
+    # Idle pool has nothing left to compact.
+    assert pool.maybe_compact() is None
+
+
+# ----------------------------------------------------------- spill tier
+
+
+def test_lru_spill_and_revival_under_hard_budget(rng):
+    # Budget = exactly one 16x16 slab; an 8x8 arrival must spill the
+    # whole LRU slab to host before its own slab fits.
+    pool = SessionPool(device_budget_bytes=16 * 16 * 4)
+    boards = {sid: _board(rng) for sid in ("a", "b", "c")}
+    for sid, b in boards.items():
+        pool.create(sid, b)
+    small = (rng.random((8, 8)) < 0.35).astype(np.uint8)
+    pool.create("d", small)
+    st = pool.stats()
+    assert st["spilled"] == 3 and st["resident"] == 1
+    assert st["spills"] == 3
+    assert pool.device_bytes() <= 16 * 16 * 4
+    # Spilled sessions still snapshot (host copy, no revival)...
+    for sid, b in boards.items():
+        np.testing.assert_array_equal(pool.snapshot(sid), b)
+    assert pool.stats()["revivals"] == 0
+    # ...and stepping one revives it (miss + revival), evicting the
+    # now-LRU 8x8 tenant to stay under budget.
+    pool.step("a", 2)
+    st = pool.stats()
+    assert st["revivals"] == 1 and st["misses"] == 1
+    np.testing.assert_array_equal(pool.snapshot("a"), oracle_n(boards["a"], 2))
+    np.testing.assert_array_equal(pool.snapshot("d"), small)
+    # A board no budget can hold is a refusal, not a wrong answer.
+    with pytest.raises(PoolError, match="budget"):
+        pool.create("big", (rng.random((64, 64)) < 0.35).astype(np.uint8))
+
+
+# ------------------------------------------------------------ WAL lifecycle
+
+
+def test_wal_pool_records_roundtrip_and_compaction_carry(tmp_path, rng):
+    w = wal.TicketWAL(tmp_path / "p.wal")
+    b0, b1 = _board(rng), _board(rng)
+    w.pool_create("alpha", b0)
+    w.pool_create("beta", b1)
+    w.pool_step("alpha", 2)
+    w.pool_step("alpha", 3)
+    w.pool_snapshot("alpha", 5)
+    w.pool_evict("beta")
+    w.close()
+
+    rep = wal.replay(tmp_path / "p.wal")
+    assert rep.counts()["pool_sessions"] == 1
+    entry = rep.pool_sessions["alpha"]
+    np.testing.assert_array_equal(entry["board"], b0)
+    assert entry["steps"] == 5  # STEP frames sum; snapshot is a no-op
+
+    # Compaction rotation carries the pool: the snapshot stores the
+    # host mirror, replay of the rotated journal restores it.
+    w2 = wal.TicketWAL(tmp_path / "p.wal")
+    w2.compact([], pool_sessions={"alpha": entry})
+    w2.pool_step("alpha", 1)
+    w2.close()
+    rep2 = wal.replay(tmp_path / "p.wal")
+    assert rep2.pool_sessions["alpha"]["steps"] == 6
+    np.testing.assert_array_equal(rep2.pool_sessions["alpha"]["board"], b0)
+
+
+def test_wal_pool_record_validation(tmp_path, rng):
+    w = wal.TicketWAL(tmp_path / "bad.wal")
+    w.pool_create("a", _board(rng))
+    w.pool_create("a", _board(rng))  # dup-live: replay must refuse
+    w.close()
+    with pytest.raises(ValueError, match="re-creates live pool session"):
+        wal.replay(tmp_path / "bad.wal")
+
+    w = wal.TicketWAL(tmp_path / "bad2.wal")
+    w.pool_step("ghost", 2)
+    w.close()
+    with pytest.raises(ValueError, match="unknown pool session"):
+        wal.replay(tmp_path / "bad2.wal")
+
+
+def test_daemon_resume_rematerializes_pool(tmp_path, rng):
+    walp = str(tmp_path / "d.wal")
+    dm = ServingDaemon(ServePolicy(max_batch=4, max_wait_s=0.0),
+                       wal_path=walp)
+    boards = {f"w{i}": _board(rng, 12) for i in range(5)}
+    for sid, b in boards.items():
+        dm.create_session(sid, b)
+    tickets = [dm.submit_session(sid, 2) for sid in boards]
+    dm.pump(drain=True)
+    assert all(t.state == DONE for t in tickets)
+    assert all(t.engine == "pool:bitsliced" for t in tickets)
+    dm.step_session("w0", 3)
+    dm.evict_session("w4")
+    dm._wal.sync()
+
+    dm2, source, detail = ServingDaemon.resume_any(
+        wal_path=walp, policy=ServePolicy(max_batch=4, max_wait_s=0.0))
+    assert source == "wal"
+    assert detail["wal_replay"]["pool_sessions"] == 4
+    assert sorted(dm2.sessions()) == ["w0", "w1", "w2", "w3"]
+    for sid in dm2.sessions():
+        steps = 2 + (3 if sid == "w0" else 0)
+        np.testing.assert_array_equal(
+            dm2.snapshot_session(sid), oracle_n(boards[sid], steps))
+    s = dm2.summary()
+    assert s["pool_sessions"] == 4
+
+
+def test_submit_session_depth_gate_and_unknown(rng):
+    dm = ServingDaemon(ServePolicy(max_batch=4, max_depth=2,
+                                   max_wait_s=0.0))
+    with pytest.raises(ValueError, match="unknown session"):
+        dm.submit_session("ghost", 1)
+    for i in range(4):
+        dm.create_session(f"s{i}", _board(rng, 12))
+    states = [dm.submit_session(f"s{i}", 2).state for i in range(4)]
+    # Depth 2: two admitted, two door-shed with the policy reason —
+    # and a shed resident step never touches the journal or the pool.
+    assert states.count(PENDING) == 2 and states.count(SHED) == 2
+    dm.pump(drain=True)
+    for i in range(4):
+        steps = 2 if states[i] == PENDING else 0
+        np.testing.assert_array_equal(
+            dm.snapshot_session(f"s{i}"),
+            oracle_n(dm._session_log[f"s{i}"]["board"], steps))
+
+
+# ------------------------------------------------------------- crash matrix
+
+
+#: (site, k): where the injected ``os._exit(137)`` lands in the pool
+#: lifecycle driver (4 sessions -> 4 creates, 8 steps, 1 snapshot,
+#: 1 evict). Every pool site fires AFTER its frame is journaled, BEFORE
+#: the pool acts; mid-frame tears a frame mid-write.
+POOL_CRASH_CELLS = [("post-create", 3), ("post-step", 5),
+                    ("post-snapshot", 1), ("post-evict", 1),
+                    ("mid-frame", 6)]
+
+
+@pytest.mark.parametrize("site,k", POOL_CRASH_CELLS)
+def test_pool_crash_matrix_resume_parity(tmp_path, site, k):
+    """The residency acceptance gate: a real subprocess daemon running
+    the handle lifecycle is hard-killed at every pool chaos site under
+    ``every-record`` fsync. Every ACKED op must be durable (creates
+    present unless acked-evicted, step sums at least the acked sum —
+    at-least-once allows ONE journaled-but-unacked op), and resume must
+    re-materialize every surviving session bit-identical to the NumPy
+    oracle replay of its journal."""
+    walp = str(tmp_path / "pool.wal")
+    ackp = str(tmp_path / "acked.ops")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MOMP_CHAOS=f"crash={site}:{k}")
+    proc = subprocess.run(
+        [sys.executable, DRIVER, walp, "every-record", ackp, "4", "pool"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == chaos.CRASH_EXIT == 137, (
+        f"crash never fired: rc={proc.returncode} "
+        f"out={proc.stdout!r} err={proc.stderr!r}")
+
+    acked = [ln.split() for ln in open(ackp).read().splitlines() if ln]
+    assert acked, "driver acked nothing — the cell tested nothing"
+    acked_creates = {op[1] for op in acked if op[0] == "C"}
+    acked_evicts = {op[1] for op in acked if op[0] == "E"}
+    acked_steps: dict[str, int] = {}
+    for op in acked:
+        if op[0] == "S":
+            acked_steps[op[1]] = acked_steps.get(op[1], 0) + int(op[2])
+
+    rep = wal.replay(walp)
+    missing = [sid for sid in acked_creates - acked_evicts
+               if sid not in rep.pool_sessions]
+    # At most ONE journaled-but-unacked EVICT can outrun its ack (the
+    # post-evict cell: frame durable, kill before the ack write).
+    assert len(missing) <= 1, (site, missing)
+    for sid in acked_evicts:
+        assert sid not in rep.pool_sessions, (site, sid)
+    for sid, steps in acked_steps.items():
+        if sid in rep.pool_sessions:
+            got = rep.pool_sessions[sid]["steps"]
+            # Exactly the acked sum, or one unacked journaled op more
+            # (journal-first: the crash landed between frame and ack).
+            assert got in (steps, steps + 2), (site, sid, got, steps)
+
+    d, source, _ = ServingDaemon.resume_any(
+        wal_path=walp, policy=ServePolicy(max_batch=4, max_wait_s=0.0))
+    assert source == "wal"
+    assert sorted(d.sessions()) == sorted(rep.pool_sessions)
+    for sid, entry in rep.pool_sessions.items():
+        np.testing.assert_array_equal(
+            d.snapshot_session(sid),
+            oracle_n(np.asarray(entry["board"]), int(entry["steps"])))
+
+
+def test_pool_driver_clean_run(tmp_path):
+    """No chaos plan: the pool driver drains clean, proving the matrix
+    cells fail for the right reason (the kill, not the workload)."""
+    walp = str(tmp_path / "clean.wal")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MOMP_CHAOS", None)
+    proc = subprocess.run(
+        [sys.executable, DRIVER, walp, "every-record",
+         str(tmp_path / "a.ops"), "4", "pool"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["sessions"] == 3
+    rep = wal.replay(walp)
+    assert sorted(rep.pool_sessions) == ["p0", "p1", "p2"]
+    assert all(e["steps"] == 4 for e in rep.pool_sessions.values())
+
+
+# ------------------------------------------------------- batcher coalescing
+
+
+def test_batcher_coalesces_small_session_groups(rng):
+    """Satellite: resident steps below BITSLICE_MIN_BATCH coalesce into
+    slab-group dispatches — 3 sessions are ONE pool dispatch, and a
+    later lone-session flush reuses the SAME compiled program (the mask
+    is runtime data)."""
+    metrics.reset()
+    pool = SessionPool()
+    # Fresh shape (see the one-compiled-program test): retrace counters
+    # only tick on a genuinely new trace.
+    boards = {f"s{i}": _board(rng, 40) for i in range(3)}
+    for sid, b in boards.items():
+        pool.create(sid, b)
+    bat = ShapeBucketBatcher(max_batch=8, pool=pool)
+    extra = _board(rng, 40)
+    t_board = bat.submit(extra, 2)
+    tks = [bat.submit_session(sid, 2) for sid in boards]
+    assert len(bat) == 4
+    assert ("slab", 0, 2) in bat.bucket_keys()
+
+    out = bat.flush()
+    # Submission-order results: the shipped board's result in place,
+    # None for resident steps (the board stayed on device).
+    assert np.array_equal(out[t_board], oracle_n(extra, 2))
+    assert all(out[t] is None for t in tks)
+    pool_stats = [s for s in bat.last_flush_stats if s.path == "pool"]
+    assert len(pool_stats) == 1 and pool_stats[0].requests == 3
+    for sid, b in boards.items():
+        np.testing.assert_array_equal(pool.snapshot(sid), oracle_n(b, 2))
+
+    bat.submit_session("s0", 2)  # lone resident step, second flush
+    bat.flush()
+    assert metrics.get("jit.retrace", fn="pool_step") == 1
+
+    with pytest.raises(ValueError, match="unknown session"):
+        bat.submit_session("ghost", 1)
+    with pytest.raises(ValueError, match="no session pool"):
+        ShapeBucketBatcher().submit_session("s0", 1)
+
+
+# ------------------------------------------------- sentinel/ledger plumbing
+
+
+def test_sentinel_polarity_and_ledger_resident_key():
+    sys.path.insert(0, os.path.join(REPO, "analysis"))
+    import regression_sentinel as sentinel
+
+    from mpi_and_open_mp_tpu.obs import ledger
+
+    assert sentinel.direction_for("session_requests_per_sec") == "higher"
+    assert sentinel.direction_for("session_vs_ship") == "higher"
+    assert sentinel.direction_for("session_p99_latency_s") == "lower"
+    assert sentinel.direction_for("pool_evictions") == "lower"
+    for f in ("session_requests_per_sec", "session_vs_ship",
+              "session_p99_latency_s", "pool_evictions"):
+        assert f in sentinel.WATCH_FIELDS
+    assert "resident" in sentinel.DEFAULT_MATCH
+    assert "resident" in ledger.KEY_FIELDS
+
+    # A resident line and a ship line must land in different baseline
+    # groups; a PRE-resident historical entry (no key field at all)
+    # must keep matching new non-resident lines.
+    pool_line = ledger.stamp({"metric": "m", "resident": "pool"})
+    ship_line = ledger.stamp({"metric": "m"})
+    old_line = {"key": {k: v for k, v in ship_line["key"].items()
+                        if k != "resident"}}
+    match = tuple(sentinel.DEFAULT_MATCH)
+    assert (ledger.config_key(pool_line, match)
+            != ledger.config_key(ship_line, match))
+    assert (ledger.config_key(old_line, match)
+            == ledger.config_key(ship_line, match))
