@@ -103,6 +103,15 @@ WATCH_FIELDS = (
     "session_vs_ship",
     "session_p99_latency_s",
     "pool_evictions",
+    # Sparse active-tile engine (PR 13): the sparse rate and its ratio
+    # over the dense roll engine measured in the same process (RTT- and
+    # noise-cancelled, like vs_cellpacked) — both higher-is-better by
+    # the cups/vs naming rules. ``active_frac`` is deliberately NOT
+    # watched: it describes the workload's liveness, not the engine's
+    # quality (a busier seed board is not a regression); it rides the
+    # line as context for the two rates that ARE watched.
+    "sparse_cups",
+    "sparse_vs_dense",
 )
 
 
@@ -131,9 +140,13 @@ def direction_for(field: str) -> str:
 #: Record fields carrying engine provenance, rank-compared for downgrades.
 PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
                      "attention_engine", "attention_hop_engine",
-                     "attention_hop_engine_bwd")
+                     "attention_hop_engine_bwd", "sparse_engine")
 
-DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch", "resident")
+#: ``workload`` joined in PR 13: a heat line and a life line of the same
+#: shape are different rules — they must never share a baseline group
+#: (pre-stencil entries default to "life" via the ledger key defaults).
+DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch", "resident",
+                 "workload")
 
 _BACKEND_RANK = {"cpu": 0, "gpu": 1, "tpu": 2}
 
@@ -144,11 +157,16 @@ def engine_rank(stamp) -> int:
     cell-packed ``batch_pack_layout`` vocabulary lands in the bottom
     tier, so ``bitsliced -> cell-packed`` is a downgrade exactly like
     ``pallas -> jnp``). Suffixes (``:b1024``, ``:zz``, ``:bB``) and the
-    ``batch:``/``local:`` prefixes don't change the tier."""
+    ``batch:``/``local:`` prefixes don't change the tier. The sparse
+    active-tile stamp (``sparse:t<tile>``) sits above everything dense:
+    on the mostly-dead workload it serves, a silent flip to
+    ``dense:crossover`` is THE downgrade this field exists to catch."""
     s = str(stamp or "")
     for prefix in ("batch:", "local:"):
         if s.startswith(prefix):
             s = s[len(prefix):]
+    if s.startswith("sparse"):
+        return 5
     if s.startswith("bitsliced"):
         return 4
     if "pallas" in s:

@@ -604,12 +604,216 @@ def _sessions_phase(s: int) -> dict:
     return fields
 
 
+def _sparse_seed_board(edge: int, tile: int) -> np.ndarray:
+    """The sparse A/B's mostly-dead Life board: blinkers parked in tile
+    INTERIORS on a coarse deterministic grid (each keeps its own tile
+    active and — via the border-band check — none of its neighbours)
+    plus one glider crossing tile boundaries (the pattern that forces
+    honest wake-up propagation). Active tile fraction stays well under
+    5% at the default 2048/64 geometry."""
+    board = np.zeros((edge, edge), dtype=np.uint8)
+    ty = edge // tile
+    stride = max(3, ty // 3)
+    placed = 0
+    for j in range(1, ty, stride):
+        for i in range(1, ty, stride):
+            if placed >= 10:
+                break
+            cy, cx = j * tile + tile // 2, i * tile + tile // 2
+            board[cy, cx - 1:cx + 2] = 1  # horizontal blinker
+            placed += 1
+    # Glider aimed across tile edges, offset so it never collides with
+    # the blinker grid (placed just off the (0, 0) tile's corner).
+    gy, gx = tile - 2, tile - 2
+    glider = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8)
+    board[gy:gy + 3, gx:gx + 3] = glider
+    return board
+
+
+def _sparse_ab_phase(n_steps: int, edge: int, tile: int) -> dict:
+    """The sparse active-tile A/B (``--sparse-ab K``): K Life steps of a
+    mostly-dead ``edge``² board through ``stencils.sparse.
+    ActiveTileEngine`` versus the dense jitted roll engine. Honesty
+    discipline matches the headline: the dense engine is parity-gated
+    against the NumPy oracle first (8 steps), the sparse final board
+    must be bit-identical to the dense final board over the FULL run,
+    and both rates are chain-differenced — two run lengths (K and 2K)
+    from fresh state, so compile/warm cost cancels on each side. The
+    ratio ``sparse_vs_dense`` is measured in one process, so machine
+    noise cancels like ``vs_cellpacked``."""
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.stencils.sparse import ActiveTileEngine
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    spec = stencils.get("life")
+    board = _sparse_seed_board(edge, tile)
+    fields = {"sparse_board": edge, "sparse_steps": n_steps,
+              "sparse_tile": tile}
+
+    # Oracle gate on the dense side (the sparse side then gates against
+    # dense over the full run — transitively oracle-exact).
+    got8 = np.asarray(stencils.run_roll(spec, board, 8))
+    ref8 = stencils.oracle_run(spec, board, 8)
+    if not np.array_equal(got8, ref8):
+        fields["sparse_error"] = "dense roll engine failed oracle parity"
+        return fields
+
+    def dense_timed(n):
+        t0 = time.perf_counter()
+        anchor_sync(stencils.run_roll(spec, board, n), fetch_all=True)
+        return time.perf_counter() - t0
+
+    # Warm (n is a runtime scalar: one compile covers both lengths).
+    anchor_sync(stencils.run_roll(spec, board, n_steps), fetch_all=True)
+    d1 = min(dense_timed(n_steps) for _ in range(2))
+    d2 = min(dense_timed(2 * n_steps) for _ in range(2))
+    dense_per_step = (d2 - d1) / n_steps if d2 > d1 else d1 / n_steps
+
+    def sparse_run(n):
+        eng = ActiveTileEngine(spec, board, tile=tile)
+        t0 = time.perf_counter()
+        out = eng.step(n)
+        dt = time.perf_counter() - t0
+        return eng, out, dt
+
+    eng1, _, s1 = sparse_run(n_steps)
+    eng2, sparse_final, s2 = sparse_run(2 * n_steps)
+    sparse_per_step = (s2 - s1) / n_steps if s2 > s1 else s1 / n_steps
+
+    dense_final = np.asarray(stencils.run_roll(spec, board, 2 * n_steps))
+    parity = np.array_equal(sparse_final, dense_final)
+    fields.update({
+        "sparse_parity": parity,
+        "sparse_cups": round(edge * edge / sparse_per_step, 1),
+        "dense_cups": round(edge * edge / dense_per_step, 1),
+        "sparse_vs_dense": round(dense_per_step / sparse_per_step, 2),
+        "active_frac": round(eng2.mean_active_frac, 6),
+        "sparse_engine": eng2.engine_stamp,
+        "sparse_counters": eng2.counters(),
+    })
+    if not parity:
+        fields["sparse_error"] = (
+            "sparse final board diverged from the dense engine")
+    return fields
+
+
+def _stencil_bench(args, state, *, platform, device_kind, degraded,
+                   backend_note) -> int:
+    """The non-life headline (``--workload NAME``): the spec-generated
+    roll engine over the workload's own seeded board, parity-gated
+    against the spec oracle, steady rate chain-differenced exactly like
+    the Life headline (run_roll's step count is a runtime scalar, so the
+    chained dispatch reuses the executable). No ``vs_baseline`` — the
+    reference MPI baseline is a Life measurement."""
+    import jax
+
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.obs import metrics as obs_metrics
+    from mpi_and_open_mp_tpu.obs import trace as obs_trace
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    spec = stencils.get(args.workload)
+    metric = _metric_name(spec.name)
+    rng = np.random.default_rng(46)
+    board = spec.init(rng, (NY, NX))
+
+    state["phase"] = "parity"
+    with obs_trace.span("bench.phase", phase="parity", workload=spec.name):
+        got = np.asarray(stencils.run_roll(spec, board, 8))
+    ref = stencils.oracle_run(spec, board, 8)
+    if not stencils.parity_ok(spec, got, ref):
+        print(json.dumps({"metric": metric, "workload": spec.name,
+                          "value": 0.0,
+                          "unit": "cell_updates_per_sec",
+                          "error": "parity check failed",
+                          "phase": "parity"}))
+        return 1
+
+    state["phase"] = "measure"
+
+    def timed(n, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            anchor_sync(stencils.run_roll(spec, board, n), fetch_all=True)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # Warm re-dispatch (the gate compiled the engine; n is runtime).
+    anchor_sync(stencils.run_roll(spec, board, STEPS), fetch_all=True)
+    best = timed(STEPS)
+    rtt_bound = best < 1.0
+    mult, reps = (161, 3) if rtt_bound else (2, 1)
+    chained = timed(STEPS * mult, reps)
+    differenced = chained > best
+    steady = (chained - best) / (mult - 1) if differenced else best
+    cups = NY * NX * STEPS / best
+    steady_cups = NY * NX * STEPS / steady
+
+    state["phase"] = "report"
+    metrics_fields = ({"metrics": obs_metrics.snapshot()}
+                      if obs_metrics.metrics_on() else {})
+    rec = {
+        "metric": metric,
+        "value": round(steady_cups, 1),
+        "unit": "cell_updates_per_sec",
+        "end_to_end_sec": round(best, 4),
+        "end_to_end_cups": round(cups, 1),
+        "steady_is_differenced": differenced,
+        "stencil_parity": True,
+        "backend": jax.default_backend(),
+        "impl": "roll",
+        "workload": spec.name,
+        "board": [NY, NX],
+        "channels": spec.channels,
+        "steps": STEPS,
+        "dtype": spec.dtype,
+        "platform": platform,
+        "device_kind": device_kind,
+        "devices": jax.device_count(),
+        "degraded": degraded,
+        **metrics_fields,
+        **backend_note,
+    }
+    print(json.dumps(rec))
+    _ledger_append(args.ledger, rec, platform=platform,
+                   device_kind=device_kind,
+                   device_count=jax.device_count())
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--board", type=int, default=None, metavar="N",
                     help="override board edge (e.g. 8192 for the big-grid "
                     "strong-scaling config); default 500 (p46gun_big)")
     ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--workload", default="life", metavar="NAME",
+                    help="stencil workload to bench (a registered "
+                    "stencils name: life, heat, gray_scott, wireworld; "
+                    "default life). Non-life workloads run the generic "
+                    "spec-engine headline (metric stencil_steady_cups_"
+                    "<name>, same parity-gate + chained-differencing "
+                    "discipline) and support --board/--steps/--trace/"
+                    "--ledger only — the life-specific phases "
+                    "(--batch/--serve/--sessions/--checkpoint-dir/"
+                    "--sparse-ab) are rejected")
+    ap.add_argument("--sparse-ab", type=int, default=0, metavar="K",
+                    help="also run the SPARSE ACTIVE-TILE A/B (life "
+                    "only): K steps of a mostly-dead --sparse-board "
+                    "board through stencils.sparse.ActiveTileEngine vs "
+                    "the dense jitted roll engine, both sides "
+                    "chain-differenced and the sparse result gated "
+                    "bit-exact against the dense one, reporting "
+                    "sparse_cups / dense_cups / sparse_vs_dense / "
+                    "active_frac on the JSON line (runs on every "
+                    "backend)")
+    ap.add_argument("--sparse-board", type=int, default=2048, metavar="N",
+                    help="board edge for the sparse A/B (default 2048; "
+                    "must be a multiple of --sparse-tile)")
+    ap.add_argument("--sparse-tile", type=int, default=64, metavar="T",
+                    help="active-tile size for the sparse A/B "
+                    "(default 64)")
     ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                     help="run the checkpointed robustness phase, writing "
                     "Orbax restart points here")
@@ -678,6 +882,29 @@ def main(argv=None) -> int:
         ap.error("--resume requires --checkpoint-dir")
     if args.fleet and not args.serve:
         ap.error("--fleet requires --serve N")
+    if args.workload != "life":
+        from mpi_and_open_mp_tpu import stencils as _stencils
+
+        try:
+            _stencils.get(args.workload)
+        except KeyError as e:
+            ap.error(str(e))
+        for flag, val in (("--batch", args.batch), ("--serve", args.serve),
+                          ("--sessions", args.sessions),
+                          ("--checkpoint-dir", args.checkpoint_dir),
+                          ("--sparse-ab", args.sparse_ab)):
+            if val:
+                ap.error(f"{flag} is a life-workload phase; "
+                         f"--workload {args.workload} runs the stencil "
+                         "headline only")
+    if args.sparse_ab:
+        if args.sparse_ab < 16:
+            ap.error("--sparse-ab needs >= 16 steps for the "
+                     "chained-differencing bracket")
+        if args.sparse_tile < 1 or args.sparse_board % args.sparse_tile:
+            ap.error(f"--sparse-board {args.sparse_board} must be a "
+                     f"positive multiple of --sparse-tile "
+                     f"{args.sparse_tile}")
     if args.trace:
         # Before any phase runs, so the sink (append-mode, cached per env
         # value) collects every span of this invocation.
@@ -703,7 +930,8 @@ def main(argv=None) -> int:
         from mpi_and_open_mp_tpu.robust.preempt import (
             EXIT_PREEMPTED, Preempted)
 
-        rec = {"metric": "life_steady_cups_p46gun_big",
+        rec = {"metric": _metric_name(args.workload),
+               "workload": args.workload,
                "error": f"{type(e).__name__}: {e}"[:300],
                "phase": state["phase"]}
         if isinstance(e, Preempted):
@@ -714,6 +942,14 @@ def main(argv=None) -> int:
         print(json.dumps(rec))
         _ledger_append(args.ledger, rec)
         return 1
+
+
+def _metric_name(workload: str) -> str:
+    """The headline metric for a workload: life keeps its historical
+    name (the ledger/sentinel history keys on it); every other stencil
+    gets ``stencil_steady_cups_<name>``."""
+    return ("life_steady_cups_p46gun_big" if workload == "life"
+            else f"stencil_steady_cups_{workload}")
 
 
 def _ledger_append(path, rec, **stamps) -> None:
@@ -783,6 +1019,11 @@ def _bench(args, state) -> int:
         device_kind = jax.devices()[0].device_kind
     except Exception:  # noqa: BLE001 — provenance must not kill the line
         device_kind = "unknown"
+
+    if args.workload != "life":
+        return _stencil_bench(args, state, platform=platform,
+                              device_kind=device_kind, degraded=res.degraded,
+                              backend_note=backend_note)
 
     from mpi_and_open_mp_tpu.models.life import LifeSim
     from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
@@ -957,6 +1198,20 @@ def _bench(args, state) -> int:
                 served.update({"session_count": args.sessions,
                                "session_error":
                                f"{type(e).__name__}: {e}"[:200]})
+
+    # Sparse active-tile A/B (opt-in via --sparse-ab K): the mostly-dead
+    # big-board scaling axis. Same failure contract as the other opt-in
+    # phases: an exception costs its fields, never the bench line.
+    sparse = {}
+    if args.sparse_ab:
+        state["phase"] = "sparse"
+        with obs_trace.span("bench.phase", phase="sparse"):
+            try:
+                sparse = _sparse_ab_phase(
+                    args.sparse_ab, args.sparse_board, args.sparse_tile)
+            except Exception as e:
+                sparse = {"sparse_board": args.sparse_board,
+                          "sparse_error": f"{type(e).__name__}: {e}"[:200]}
 
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device
@@ -1227,6 +1482,7 @@ def _bench(args, state) -> int:
         "board": [NY, NX],
         "steps": STEPS,
         "dtype": "uint8",
+        "workload": "life",
         "platform": platform,
         "device_kind": device_kind,
         "devices": jax.device_count(),
@@ -1237,6 +1493,7 @@ def _bench(args, state) -> int:
         **ckpt_fields,
         **batched,
         **served,
+        **sparse,
         **sharded,
         **prof_fields,
         **trace_fields,

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# r07 queued increment (ISSUE 13, DESIGN.md §15): stencil spec
+# subsystem on the real chip — one non-life workload through the
+# generic engine (gray_scott: two-channel float32, parity-gated
+# stencil_steady_cups line), then the sparse active-tile A/B at the
+# acceptance geometry (2048^2, ~1% active, tile 64): the sparse engine
+# must clear the dense roll path with bit-exact parity, and the line's
+# sparse_engine stamp (sparse:t64 vs dense:crossover) is what the
+# sentinel ranks, so a silent fallback on-chip flags as a downgrade.
+# Both lines land in MOMP_LEDGER (exported by tpu_queue_loop.sh) under
+# the workload-keyed baseline groups. One chip process per bench run,
+# sequential; exits nonzero on failure so the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python bench.py --workload gray_scott --board 1024 --steps 500
+
+python bench.py --sparse-ab 200 --sparse-board 2048 --sparse-tile 64

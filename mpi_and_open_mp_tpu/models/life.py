@@ -60,6 +60,17 @@ at step ``i``, save a snapshot when ``i % save_steps == 0`` (i.e. *before*
 stepping), then advance one step. Collect-to-host is ``jax.device_get`` of
 the sharded array — the ``MPI_Gather``/manual-recv-loop equivalent
 (``5-gather/life_mpi.c:178``, ``3-life/life_mpi.c:185-196``).
+
+Since the stencil subsystem (``mpi_and_open_mp_tpu.stencils``) landed,
+the sim is workload-generic: ``workload="life"`` (the default) is the
+historical behaviour bit-for-bit, while any other registered
+:class:`~mpi_and_open_mp_tpu.stencils.StencilSpec` (heat, gray_scott,
+wireworld, ...) runs through the SAME roll / halo / generic-Pallas
+machinery — spec dtype, spec oracle, spec domain check, channel axes
+riding in front of the sharded board axes. The bit-packed engines
+(``bitfused`` and the batched native dispatch) encode Life's 0/1 state
+specifically, so they stay ``life``-only; ``impl="auto"`` for other
+workloads picks ``halo`` when the board divides the mesh, else ``roll``.
 """
 
 from __future__ import annotations
@@ -90,13 +101,19 @@ IMPLS = ("auto", "roll", "halo", "pallas", "bitfused")
 _BITFUSED_1DEV_SERIAL_ON_CPU = False
 
 
-def _layout_spec(layout: str) -> P:
-    return {
-        "serial": P(),
-        "row": P("y", None),
-        "col": P(None, "x"),
-        "cart": P("y", "x"),
+def _layout_spec(layout: str, channels: int = 1) -> P:
+    axes = {
+        "serial": (),
+        "row": ("y", None),
+        "col": (None, "x"),
+        "cart": ("y", "x"),
     }[layout]
+    # Multi-channel stencils carry the channel axis in FRONT of the board
+    # axes; it is never sharded (every device owns all fields of its
+    # cells, the layout that keeps the update local).
+    if channels > 1 and axes:
+        axes = (None, *axes)
+    return P(*axes)
 
 
 def _default_mesh(layout: str) -> Mesh | None:
@@ -128,11 +145,15 @@ def _ceil_to(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _oracle_step(board: np.ndarray) -> np.ndarray:
-    """One NumPy-oracle step; a (B, ny, nx) stack steps per board."""
-    if board.ndim == 3:
-        return np.stack([life_ops.life_step_numpy(b) for b in board])
-    return life_ops.life_step_numpy(board)
+def _oracle_step(board: np.ndarray, spec) -> np.ndarray:
+    """One NumPy-oracle step of ``spec``; for single-channel specs a
+    (B, ny, nx) stack steps per board (a multi-channel 3D array IS one
+    board — channels lead, there is no batched multi-channel mode)."""
+    from mpi_and_open_mp_tpu.stencils import step_numpy
+
+    if spec.channels == 1 and board.ndim == 3:
+        return np.stack([step_numpy(spec, b) for b in board])
+    return step_numpy(spec, board)
 
 
 def _note_retrace(fn: str) -> None:
@@ -170,17 +191,41 @@ class LifeSim:
         impl: str = "auto",
         mesh: Mesh | None = None,
         fuse_steps: int = 1,
-        dtype=jnp.uint8,
+        dtype=None,
         outdir: str | os.PathLike | None = None,
         checkpoint_dir: str | os.PathLike | None = None,
         checkpoint_every: int = 0,
         initial_board: np.ndarray | None = None,
         initial_step: int = 0,
+        workload: str = "life",
     ):
+        from mpi_and_open_mp_tpu import stencils
+
         if layout not in LAYOUTS:
             raise ValueError(f"layout must be one of {LAYOUTS}, got {layout!r}")
         if impl not in IMPLS:
             raise ValueError(f"impl must be one of {IMPLS}, got {impl!r}")
+        self.workload = str(workload)
+        self.spec = stencils.get(self.workload)
+        if dtype is None:
+            # Historical default for life (uint8) IS the spec dtype, so
+            # the pre-workload constructor signature is unchanged in
+            # behaviour; other specs bring their own cell dtype.
+            dtype = jnp.dtype(self.spec.dtype)
+        self._np_dtype = self.spec.np_dtype
+        if self.workload != "life":
+            # The bit-packed engines encode Life's 0/1 state; everything
+            # else runs the generic roll / halo / generic-Pallas paths.
+            if impl == "bitfused":
+                raise ValueError(
+                    f"impl='bitfused' is a bit-packed Life engine; "
+                    f"workload={self.workload!r} runs 'roll', 'halo' or "
+                    "'pallas' (sharded)")
+            if impl == "pallas" and layout == "serial":
+                raise ValueError(
+                    "serial impl='pallas' dispatches the bit-packed Life "
+                    f"VMEM engine; workload={self.workload!r} uses "
+                    "impl='roll' (serial) or 'pallas' on a sharded layout")
         # Batched mode: a STACKED (B, ny, nx) initial board advances all B
         # independent boards per dispatch through the batched native
         # engines (ops.pallas_life.life_run_vmem_batch) — the model-layer
@@ -189,7 +234,17 @@ class LifeSim:
         # not one mesh program), and no VTK/checkpoint channels (both
         # serialise ONE board; batched runs are throughput runs).
         self.batch: int | None = None
-        if initial_board is not None and np.asarray(initial_board).ndim == 3:
+        if (initial_board is not None
+                and np.asarray(initial_board).ndim
+                == 3 + (self.spec.channels > 1)):
+            if self.spec.channels > 1 or self.workload != "life":
+                # A 3D multi-channel array is ONE board (channels lead);
+                # stacks of non-life boards are the serve layer's
+                # bucketing problem, not a model-layer mode — the batched
+                # native engines are bit-packed Life.
+                raise ValueError(
+                    f"workload={self.workload!r} has no batched mode; "
+                    "submit stacks through the serve batcher instead")
             if layout != "serial":
                 raise ValueError(
                     "stacked (B, ny, nx) boards need layout='serial'; "
@@ -226,10 +281,14 @@ class LifeSim:
         divisible = _divisible(cfg.shape, layout, self.mesh)
         plan = (
             self._bitfused_plan(layout, cfg.shape)
-            if impl in ("auto", "bitfused")
+            if impl in ("auto", "bitfused") and self.workload == "life"
             else None
         )
-        if impl == "auto":
+        if impl == "auto" and self.workload != "life":
+            # Generic-spec auto: the explicit-halo shard_map path when
+            # the board divides the mesh, else the global roll step.
+            impl = "halo" if (layout != "serial" and divisible) else "roll"
+        elif impl == "auto":
             on_tpu = jax.default_backend() == "tpu"
             if self.batch is not None:
                 # The batched dispatcher compiles on EVERY backend (off-TPU
@@ -281,15 +340,16 @@ class LifeSim:
         if impl in ("halo", "pallas") and layout != "serial":
             py, px = _mesh_divisors(layout, self.mesh)
             local = min(cfg.ny // py, cfg.nx // px)
-            if self.fuse_steps > local:
+            if self.fuse_steps * self.spec.radius > local:
                 raise ValueError(
-                    f"fuse_steps={self.fuse_steps} exceeds the smallest local "
-                    f"shard extent ({local}); a halo cannot be deeper than "
-                    f"the shard it pads"
+                    f"fuse_steps={self.fuse_steps} x radius "
+                    f"{self.spec.radius} exceeds the smallest local shard "
+                    f"extent ({local}); a halo cannot be deeper than the "
+                    f"shard it pads"
                 )
 
         self.sharding = (
-            NamedSharding(self.mesh, _layout_spec(layout))
+            NamedSharding(self.mesh, _layout_spec(layout, self.spec.channels))
             if self.mesh is not None
             else None
         )
@@ -304,20 +364,25 @@ class LifeSim:
             py, px = _mesh_divisors(layout, self.mesh)
             self.padded_shape = (_ceil_to(cfg.ny, py), _ceil_to(cfg.nx, px))
         if initial_board is not None:
-            board = np.asarray(initial_board, dtype=np.uint8)
+            board = np.asarray(initial_board, dtype=self._np_dtype)
             expect = (
                 (self.batch, *cfg.shape) if self.batch is not None
-                else cfg.shape
+                else self.spec.board_shape(*cfg.shape)
             )
             if board.shape != expect:
                 raise ValueError(
                     f"initial_board {board.shape} != expected {expect}"
                 )
-        else:
+        elif self.workload == "life":
             board = cfg.board()
+        else:
+            # Non-life boards come from the spec's own initialiser (the
+            # LifeConfig cell list encodes Life patterns specifically).
+            board = self.spec.init(np.random.default_rng(0xD1CE), cfg.shape)
         if self.batch is None and self.padded_shape != cfg.shape:
-            full = np.zeros(self.padded_shape, dtype=board.dtype)
-            full[: cfg.ny, : cfg.nx] = board
+            full = np.zeros(
+                self.spec.board_shape(*self.padded_shape), dtype=board.dtype)
+            full[..., : cfg.ny, : cfg.nx] = board
             board = full
         self._initial = board
         self._initial_step = int(initial_step)
@@ -330,13 +395,15 @@ class LifeSim:
     # ---------------------------------------------------------- step builders
 
     def _local_fused_step(self, block: jnp.ndarray, k: int) -> jnp.ndarray:
-        """Halo-pad a shard to depth ``k`` and take ``k`` fused local steps."""
+        """Halo-pad a shard to depth ``k * radius`` and take ``k`` fused
+        local steps (each step consumes ``radius`` halo cells per side)."""
+        d = k * self.spec.radius
         if self.layout == "row":
-            padded = halo.halo_pad_y(life_ops.pad_x_wrap(block, k), "y", k)
+            padded = halo.halo_pad_y(life_ops.pad_x_wrap(block, d), "y", d)
         elif self.layout == "col":
-            padded = halo.halo_pad_x(life_ops.pad_y_wrap(block, k), "x", k)
+            padded = halo.halo_pad_x(life_ops.pad_y_wrap(block, d), "x", d)
         else:  # cart
-            padded = halo.halo_pad_2d(block, "y", "x", k)
+            padded = halo.halo_pad_2d(block, "y", "x", d)
         for _ in range(k):
             padded = self._padded_step(padded)
         return padded
@@ -345,8 +412,12 @@ class LifeSim:
         if self.impl == "pallas":
             from mpi_and_open_mp_tpu.ops import pallas_life
 
-            return pallas_life.life_step_padded_pallas(padded)
-        return life_ops.life_step_padded(padded)
+            if self.workload == "life":
+                return pallas_life.life_step_padded_pallas(padded)
+            return pallas_life.stencil_step_padded_pallas(self.spec, padded)
+        from mpi_and_open_mp_tpu.stencils import step_padded
+
+        return step_padded(self.spec, padded, jnp)
 
     def _build_advance(self) -> Callable[[jnp.ndarray, int], jnp.ndarray]:
         """Return ``advance(board, n)`` running ``n`` steps, jit-cached on ``n``."""
@@ -367,10 +438,14 @@ class LifeSim:
             return advance
 
         if self.impl == "roll" or self.layout == "serial":
+            from mpi_and_open_mp_tpu.stencils import step_roll
+
             sharding = self.sharding
+            spec_ = self.spec
             ny, nx = self.cfg.shape
             pad_y = self.padded_shape[0] - ny
             pad_x = self.padded_shape[1] - nx
+            lead = ((0, 0),) if spec_.channels > 1 else ()
 
             @functools.partial(jax.jit, static_argnums=1)
             def advance(board, n):
@@ -378,10 +453,10 @@ class LifeSim:
 
                 def body(_, b):
                     if pad_y or pad_x:
-                        v = life_ops.life_step_roll(b[:ny, :nx])
-                        b = jnp.pad(v, ((0, pad_y), (0, pad_x)))
+                        v = step_roll(spec_, b[..., :ny, :nx], jnp)
+                        b = jnp.pad(v, (*lead, (0, pad_y), (0, pad_x)))
                     else:
-                        b = life_ops.life_step_roll(b)
+                        b = step_roll(spec_, b, jnp)
                     if sharding is not None:
                         b = lax.with_sharding_constraint(b, sharding)
                     return b
@@ -391,7 +466,7 @@ class LifeSim:
             return advance
 
         # shard_map halo/pallas path, with k-step fusion per exchange round.
-        spec = _layout_spec(self.layout)
+        spec = _layout_spec(self.layout, self.spec.channels)
         k = self.fuse_steps
 
         def make_smapped(kk: int):
@@ -663,15 +738,23 @@ class LifeSim:
         hooks as the segment program (faults are sticky at trace time), so
         a poisoned exchange cannot hide from the probe.
         """
+        from mpi_and_open_mp_tpu.stencils import parity_ok
+
         before = self.collect()
-        if not np.isin(before, (0, 1)).all():
-            return "non-binary cells on the board"
+        if not self.spec.valid_board(before):
+            # Life/wireworld: out-of-range automaton state; float
+            # stencils: non-finite cells. Either way the value invariant
+            # broke before the step-parity probe even ran.
+            return ("out-of-domain cells on the board"
+                    if self.workload != "life"
+                    else "non-binary cells on the board")
         after_impl = np.asarray(
-            jax.device_get(self._advance(self.board, 1)), dtype=np.uint8
+            jax.device_get(self._advance(self.board, 1)),
+            dtype=self._np_dtype,
         )[..., : self.cfg.ny, : self.cfg.nx]
-        expect = _oracle_step(before)
-        if not np.array_equal(after_impl, expect):
-            if after_impl.ndim == 3:
+        expect = _oracle_step(before, self.spec)
+        if not parity_ok(self.spec, after_impl, expect):
+            if self.batch is not None:
                 # PER-BOARD honesty: name every diverging board of the
                 # stack, not just "the batch diverged".
                 bad = [
@@ -696,9 +779,9 @@ class LifeSim:
         # with near-certainty.
         probe, probe_expect = self._probe_case()
         after_probe = np.asarray(
-            jax.device_get(self._advance(probe, 1)), dtype=np.uint8
+            jax.device_get(self._advance(probe, 1)), dtype=self._np_dtype
         )[..., : self.cfg.ny, : self.cfg.nx]
-        if not np.array_equal(after_probe, probe_expect):
+        if not parity_ok(self.spec, after_probe, probe_expect):
             diff = int((after_probe != probe_expect).sum())
             return (
                 f"{diff} cells diverge from the oracle after one "
@@ -710,22 +793,31 @@ class LifeSim:
         """Cached ``(device_board, oracle_next)`` for the fixed-probe leg of
         ``_consistency_violation`` — placed exactly like the live board."""
         if self._probe is None:
-            shape = (self.cfg.ny, self.cfg.nx)
-            if self.batch is not None:
-                # B DISTINCT dense boards (one rng stream): a fault that
-                # corrupts only some stack positions must still perturb
-                # the board that sits there.
-                shape = (self.batch, *shape)
-            host = np.random.default_rng(0xC0FFEE).integers(
-                0, 2, shape, dtype=np.uint8)
-            if self.batch is None and self.padded_shape != host.shape:
-                full = np.zeros(self.padded_shape, dtype=np.uint8)
-                full[: self.cfg.ny, : self.cfg.nx] = host
+            rng = np.random.default_rng(0xC0FFEE)
+            if self.workload == "life":
+                shape = (self.cfg.ny, self.cfg.nx)
+                if self.batch is not None:
+                    # B DISTINCT dense boards (one rng stream): a fault
+                    # that corrupts only some stack positions must still
+                    # perturb the board that sits there.
+                    shape = (self.batch, *shape)
+                host = rng.integers(0, 2, shape, dtype=np.uint8)
+            else:
+                # The spec's own initialiser is the dense-enough probe
+                # state for non-life rules (batched mode is life-only).
+                host = np.asarray(
+                    self.spec.init(rng, self.cfg.shape),
+                    dtype=self._np_dtype)
+            if self.batch is None and self.padded_shape != self.cfg.shape:
+                full = np.zeros(
+                    self.spec.board_shape(*self.padded_shape),
+                    dtype=self._np_dtype)
+                full[..., : self.cfg.ny, : self.cfg.nx] = host
             else:
                 full = host
             b = jnp.asarray(full, dtype=self.dtype)
             b = jax.device_put(b, self.sharding) if self.sharding else b
-            self._probe = (b, _oracle_step(host))
+            self._probe = (b, _oracle_step(host, self.spec))
         return self._probe
 
     def debug_check(self) -> None:
@@ -746,10 +838,13 @@ class LifeSim:
     def _set_board(self, board: np.ndarray, step: int) -> None:
         """Install a host board as the live state (pad + device_put), the
         same placement the constructor performs."""
-        board = np.asarray(board, dtype=np.uint8)
-        if self.batch is None and self.padded_shape != board.shape:
-            full = np.zeros(self.padded_shape, dtype=np.uint8)
-            full[: self.cfg.ny, : self.cfg.nx] = board
+        board = np.asarray(board, dtype=self._np_dtype)
+        if (self.batch is None
+                and board.shape[-2:] != tuple(self.padded_shape)):
+            full = np.zeros(
+                self.spec.board_shape(*self.padded_shape),
+                dtype=self._np_dtype)
+            full[..., : self.cfg.ny, : self.cfg.nx] = board
             board = full
         b = jnp.asarray(board, dtype=self.dtype)
         self.board = jax.device_put(b, self.sharding) if self.sharding else b
@@ -791,10 +886,10 @@ class LifeSim:
             self.recoveries.append(f"{stamp} ({why})")
             guards.record_recovery(stamp)
             return
-        board = np.asarray(jax.device_get(prev_board), dtype=np.uint8)[
+        board = np.asarray(jax.device_get(prev_board), dtype=self._np_dtype)[
             ..., : self.cfg.ny, : self.cfg.nx]
         for _ in range(n):
-            board = _oracle_step(board)
+            board = _oracle_step(board, self.spec)
         self._set_board(board, prev_step + n)
         stamp = "life_step:numpy-oracle:recovered"
         self.recoveries.append(f"{stamp} ({why}; then {still})")
@@ -826,13 +921,14 @@ class LifeSim:
         (``5-gather/life_mpi.c:178``).
         """
         if self.board.is_fully_addressable:
-            full = np.asarray(jax.device_get(self.board), dtype=np.uint8)
+            full = np.asarray(
+                jax.device_get(self.board), dtype=self._np_dtype)
         else:
             from jax.experimental import multihost_utils
 
             full = np.asarray(
                 multihost_utils.process_allgather(self.board, tiled=True),
-                dtype=np.uint8,
+                dtype=self._np_dtype,
             )
         # Ellipsis crop: batched boards are (B, ny, nx), the crop applies
         # to the trailing board axes either way.

@@ -50,8 +50,12 @@ ENV = "MOMP_LEDGER"
 #: pallas → jnp). ``resident`` joined in PR 12: a device-resident
 #: session-pool run and a ship-boards-every-call run measure different
 #: serving disciplines, so they must never share a baseline group.
+#: ``workload`` joined in PR 13 (the stencil spec subsystem): a heat run
+#: and a life run at the same shape are different rules entirely —
+#: entries stamped before the field existed default to "life", which is
+#: exactly what they ran.
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
-              "batch_pack_layout", "resident", "engine")
+              "batch_pack_layout", "resident", "workload", "engine")
 
 _GIT_SHA: str | None = None
 
@@ -113,6 +117,8 @@ def stamp(record: dict, *, source: str = "bench.py",
         # "-" for lines without a sessions phase; "pool" when the record
         # carries device-resident session-pool measurements.
         "resident": record.get("resident", "-"),
+        # Pre-stencil lines carry no workload field: life, exactly.
+        "workload": record.get("workload", "life"),
         "engine": record.get("impl", "?"),
     }
     return {
@@ -163,7 +169,8 @@ def load(path: str) -> list[dict]:
 #: Key fields whose absence means "not applicable" rather than
 #: "unrecorded": entries stamped before the field joined KEY_FIELDS must
 #: keep matching new lines that carry the explicit "-" placeholder.
-_KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-"}
+_KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-",
+                 "workload": "life"}
 
 
 def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:

@@ -51,13 +51,16 @@ def life_step_numpy(board: np.ndarray) -> np.ndarray:
 def life_step_roll(board: jnp.ndarray) -> jnp.ndarray:
     """Global torus step via circular shifts.
 
-    Separable form: 4 rolls instead of 8 — row-sum first, then column rolls,
-    subtracting the centre. On a sharded array XLA turns the axis-0/axis-1
-    rolls into ``collective-permute`` over the mesh automatically.
+    Generated from the ``life`` :class:`~..stencils.StencilSpec` since the
+    stencil subsystem landed: the all-ones radius-1 box takes the engine's
+    separable fast path — 4 rolls instead of 8, the exact roll sequence
+    this function carried by hand before — so the step stays bit-identical
+    (uint8 sums are order-exact either way). On a sharded array XLA turns
+    the axis rolls into ``collective-permute`` over the mesh automatically.
     """
-    rows = board + jnp.roll(board, 1, axis=0) + jnp.roll(board, -1, axis=0)
-    n = rows + jnp.roll(rows, 1, axis=1) + jnp.roll(rows, -1, axis=1) - board
-    return life_rule(board, n)
+    from mpi_and_open_mp_tpu.stencils import LIFE, step_roll
+
+    return step_roll(LIFE, board, jnp)
 
 
 def life_step_padded(padded: jnp.ndarray) -> jnp.ndarray:
@@ -68,26 +71,23 @@ def life_step_padded(padded: jnp.ndarray) -> jnp.ndarray:
     from a torus wrap (serial) or a ``ppermute`` halo exchange (sharded;
     the explicit equivalent of the reference's ghost-row ``MPI_Send/Recv``
     at ``3-life/life_mpi.c:198-209``). Returns the ``(h, w)`` interior.
+    Generated from the ``life`` spec (pure slicing, so it drops into the
+    Pallas kernel and ``shard_map`` bodies unchanged, any radius/dtype).
     """
-    c = padded[1:-1, 1:-1]
-    n = (
-        padded[:-2, :-2]
-        + padded[:-2, 1:-1]
-        + padded[:-2, 2:]
-        + padded[1:-1, :-2]
-        + padded[1:-1, 2:]
-        + padded[2:, :-2]
-        + padded[2:, 1:-1]
-        + padded[2:, 2:]
-    )
-    return life_rule(c, n)
+    from mpi_and_open_mp_tpu.stencils import LIFE, step_padded
+
+    return step_padded(LIFE, padded, jnp)
 
 
 def pad_x_wrap(block: jnp.ndarray, depth: int = 1) -> jnp.ndarray:
-    """Pad the x (last) axis with its own torus wrap (shard owns full width)."""
-    return jnp.concatenate([block[:, -depth:], block, block[:, :depth]], axis=1)
+    """Pad the x (last) axis with its own torus wrap (shard owns full
+    width). Ellipsis indexing: leading batch/channel axes ride along."""
+    return jnp.concatenate(
+        [block[..., -depth:], block, block[..., :depth]], axis=-1)
 
 
 def pad_y_wrap(block: jnp.ndarray, depth: int = 1) -> jnp.ndarray:
-    """Pad the y (first) axis with its own torus wrap (shard owns full height)."""
-    return jnp.concatenate([block[-depth:, :], block, block[:depth, :]], axis=0)
+    """Pad the y (second-to-last) axis with its own torus wrap (shard owns
+    full height). Leading batch/channel axes ride along."""
+    return jnp.concatenate(
+        [block[..., -depth:, :], block, block[..., :depth, :]], axis=-2)

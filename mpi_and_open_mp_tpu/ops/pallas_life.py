@@ -233,6 +233,43 @@ def _padded_step_kernel(p_ref, out_ref):
     out_ref[:] = life_ops.life_step_padded(p_ref[:])
 
 
+def stencil_step_padded_pallas(spec, padded: jnp.ndarray) -> jnp.ndarray:
+    """Spec-generic Pallas twin of :func:`life_step_padded_pallas`: one
+    stencil step over a ``radius``-halo-padded block (channels on the
+    leading axis ride through), generated from any
+    :class:`~..stencils.StencilSpec`.
+
+    The kernel body is ``stencils.engine.step_padded`` — pure slicing +
+    the spec's ``update``, the same code the jnp path runs, so Mosaic
+    sees a static-shape VPU stencil regardless of rule. Integer specs
+    compute in int32 inside the kernel (sub-word dtypes hit Mosaic
+    layout gaps — same cast the life kernel carries); float specs stay
+    in their native dtype. Over-VMEM blocks take the compiled jnp
+    stencil, like the life kernel.
+    """
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+
+    r = spec.radius
+    h, w = padded.shape[-2] - 2 * r, padded.shape[-1] - 2 * r
+    dtype = padded.dtype
+    out_shape = (*padded.shape[:-2], h, w)
+    if padded.size * 4 > _VMEM_BYTES_LIMIT:
+        return stencil_engine.step_padded(spec, padded, jnp)
+    compute = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.int32
+
+    def kernel(p_ref, out_ref):
+        out_ref[:] = stencil_engine.step_padded(spec, p_ref[:], jnp)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, compute),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(padded.astype(compute))
+    return out.astype(dtype)
+
+
 def life_step_padded_pallas(padded: jnp.ndarray) -> jnp.ndarray:
     """Pallas version of ``ops.life_step_padded``: step the interior of a
     halo-padded ``(h+2, w+2)`` block, returning ``(h, w)``.

@@ -80,15 +80,21 @@ def halo_pad_y(block: jnp.ndarray, axis_name: str = "y", depth: int = 1) -> jnp.
     Returns ``(h + 2*depth, w)``: ``depth`` rows from the previous shard on
     top, ``depth`` rows from the next shard at the bottom. With a single
     shard on the axis this degenerates to a torus self-wrap.
+
+    Row/column axes are the LAST TWO axes — leading channel axes (multi-
+    field stencils like gray_scott) ride through untouched, and ``depth``
+    is the stencil radius times any fuse depth, so every stencil spec
+    shares this one exchange (dtype never appears: ``ppermute`` moves
+    whatever the slice holds).
     """
     _note_exchange("y", axis_name)
     p = _axis_size(axis_name)
     # My top ghost rows are the *last* rows of my predecessor: everyone
     # sends their bottom edge forward around the ring.
     top = _chaos_ghost(
-        lax.ppermute(block[-depth:, :], axis_name, ring_perm(p, 1)))
-    bot = lax.ppermute(block[:depth, :], axis_name, ring_perm(p, -1))
-    return jnp.concatenate([top, block, bot], axis=0)
+        lax.ppermute(block[..., -depth:, :], axis_name, ring_perm(p, 1)))
+    bot = lax.ppermute(block[..., :depth, :], axis_name, ring_perm(p, -1))
+    return jnp.concatenate([top, block, bot], axis=-2)
 
 
 def halo_pad_x(block: jnp.ndarray, axis_name: str = "x", depth: int = 1) -> jnp.ndarray:
@@ -96,13 +102,15 @@ def halo_pad_x(block: jnp.ndarray, axis_name: str = "x", depth: int = 1) -> jnp.
 
     The reference needed ``MPI_Type_vector`` strided datatypes for this
     (``4-life/life_mpi.c:106-109``); here it is a slice + ``ppermute``.
+    Last-axis columns; leading channel axes ride along (see
+    :func:`halo_pad_y` for the radius/dtype-generic contract).
     """
     _note_exchange("x", axis_name)
     p = _axis_size(axis_name)
     left = _chaos_ghost(
-        lax.ppermute(block[:, -depth:], axis_name, ring_perm(p, 1)))
-    right = lax.ppermute(block[:, :depth], axis_name, ring_perm(p, -1))
-    return jnp.concatenate([left, block, right], axis=1)
+        lax.ppermute(block[..., -depth:], axis_name, ring_perm(p, 1)))
+    right = lax.ppermute(block[..., :depth], axis_name, ring_perm(p, -1))
+    return jnp.concatenate([left, block, right], axis=-1)
 
 
 def packed_halo_y(

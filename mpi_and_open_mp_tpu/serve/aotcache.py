@@ -18,9 +18,11 @@ the traced Python bodies, so a warm resume shows ZERO retraces.
 **Keying: a fingerprint, not a filename convention.** Every artifact is
 keyed by the full fingerprint of what made the program: stack shape,
 dtype, the steps signature (runtime int32 scalar), batch bucket, the
-engine path ``native_path_batch`` would pick, jax/jaxlib versions,
-platform/device kind/topology, and a content hash of the engine source
-files (``ops/bitlife.py`` + ``ops/pallas_life.py``). The digest of that
+engine path ``native_path_batch`` would pick, the stencil workload the
+program advances, jax/jaxlib versions, platform/device kind/topology,
+and a content hash of the engine source files (``ops/bitlife.py`` +
+``ops/pallas_life.py`` + the ``stencils`` spec/engine the life step is
+generated from). The digest of that
 fingerprint is the filename; the fingerprint itself is stored INSIDE
 the envelope and re-verified on load, so a stale artifact (upgraded
 jax, edited kernels, different chip) can never be executed — it is
@@ -93,9 +95,14 @@ def code_fingerprint() -> str:
     global _CODE_FP
     if _CODE_FP is None:
         from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+        from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+        from mpi_and_open_mp_tpu.stencils import spec as stencil_spec
 
         h = hashlib.sha256()
-        for mod in (bitlife, pallas_life):
+        # The stencil engine/spec sources are part of the hash because
+        # the life padded step is GENERATED from them now — editing the
+        # generic engine can change the compiled life program.
+        for mod in (bitlife, pallas_life, stencil_engine, stencil_spec):
             with open(mod.__file__, "rb") as fd:
                 h.update(fd.read())
         _CODE_FP = h.hexdigest()[:16]
@@ -103,7 +110,8 @@ def code_fingerprint() -> str:
 
 
 def fingerprint(stack_shape: tuple[int, int, int], dtype, *,
-                program: str = "bucket", donated: bool = False) -> dict:
+                program: str = "bucket", donated: bool = False,
+                workload: str = "life") -> dict:
     """The full cache key for one compiled program — everything that can
     change the executable or its validity. ``program`` names which
     program family the key identifies (``"bucket"`` for the daemon's
@@ -111,7 +119,10 @@ def fingerprint(stack_shape: tuple[int, int, int], dtype, *,
     donated in-place step); ``donated`` is keyed because input aliasing
     changes the executable's buffer contract even at identical shapes.
     Donation does not survive ``jax.export``, so pool-step keys are
-    identity stamps for the in-process jit cache, never load targets."""
+    identity stamps for the in-process jit cache, never load targets.
+    ``workload`` is the stencil rule the program advances — keyed so a
+    life artifact can never serve a heat bucket of the same shape (only
+    life programs are cached today; the field future-proofs the key)."""
     import jax
     import jaxlib
 
@@ -130,6 +141,7 @@ def fingerprint(stack_shape: tuple[int, int, int], dtype, *,
         "bucket": b,
         "program": str(program),
         "donated": bool(donated),
+        "workload": str(workload),
         "steps": STEPS_SIGNATURE,
         "engine_path": "batch:" + pallas_life.native_path_batch(
             (b, ny, nx), on_tpu=on_tpu),

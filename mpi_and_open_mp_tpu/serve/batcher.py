@@ -1,4 +1,8 @@
-"""Shape-bucketed micro-batch dispatcher for Life boards.
+"""Shape-bucketed micro-batch dispatcher for stencil boards.
+
+Buckets key on ``(shape, dtype, workload)`` — life rides the native
+bit-packed batch engines, every other registered ``stencils`` workload
+dispatches through the spec-generated vmapped roll engine.
 
 See the package docstring for the serving model. The implementation is
 deliberately host-side and synchronous — a queue of submitted boards,
@@ -82,6 +86,7 @@ class _Request:
     ticket: int
     board: np.ndarray
     steps: int
+    workload: str = "life"
 
 
 @dataclass
@@ -131,20 +136,33 @@ class ShapeBucketBatcher:
     def __len__(self) -> int:
         return len(self._queue) + len(self._session_queue)
 
-    def submit(self, board: np.ndarray, steps: int) -> int:
-        """Enqueue one board for ``steps`` Life steps; returns a ticket
-        (the request's index in the next flush's result list)."""
+    def submit(self, board: np.ndarray, steps: int,
+               workload: str = "life") -> int:
+        """Enqueue one board for ``steps`` stencil steps under
+        ``workload`` (a registered ``stencils`` name, default life);
+        returns a ticket (the request's index in the next flush's
+        result list)."""
+        from mpi_and_open_mp_tpu import stencils
+
+        try:
+            spec = stencils.get(workload)
+        except KeyError as e:
+            raise ValueError(str(e)) from None
         board = np.asarray(board)
-        if board.ndim != 2:
+        if (board.ndim < 2
+                or board.shape != spec.board_shape(*board.shape[-2:])):
+            want = ("3D (channels, ny, nx)" if spec.channels > 1
+                    else "2D (ny, nx)")
             raise ValueError(
-                f"submit: one 2D board per request, got shape {board.shape}"
+                f"submit: workload {workload!r} wants one {want} board "
+                f"per request, got shape {board.shape}"
                 " (stacks are the ENGINE layout; the batcher builds them)")
         steps = int(steps)
         if steps < 0:
             raise ValueError(f"submit: steps must be >= 0, got {steps}")
         ticket = self._next_ticket
         self._next_ticket += 1
-        self._queue.append(_Request(ticket, board, steps))
+        self._queue.append(_Request(ticket, board, steps, str(workload)))
         return ticket
 
     def submit_session(self, session: str, steps: int) -> int:
@@ -169,12 +187,14 @@ class ShapeBucketBatcher:
 
     def bucket_keys(self) -> list[tuple]:
         """The distinct buckets currently queued, in first-submission
-        order: ``(shape, dtype)`` for board requests, ``("slab",
-        slab_id, steps)`` for resident-session steps (sessions sharing
-        a slab and step count coalesce into one in-place dispatch)."""
+        order: ``(shape, dtype, workload)`` for board requests,
+        ``("slab", slab_id, steps)`` for resident-session steps
+        (sessions sharing a slab and step count coalesce into one
+        in-place dispatch)."""
         seen: dict[tuple, None] = {}
         for r in self._queue:
-            seen.setdefault((r.board.shape, r.board.dtype.str), None)
+            seen.setdefault(
+                (r.board.shape, r.board.dtype.str, r.workload), None)
         for _, sid, steps in self._session_queue:
             h = self._pool.handle(sid)
             slab = -1 if h is None else h.slab  # spilled: placed at flush
@@ -192,18 +212,23 @@ class ShapeBucketBatcher:
         stats: list[_BatchStat] = []
         on_tpu = jax.default_backend() == "tpu"
 
-        # Bucket by (shape, dtype), sub-group by steps, chunk at
-        # max_batch. Grouping is order-preserving within a bucket so the
-        # span/ticket bookkeeping reads naturally in traces.
+        # Bucket by (shape, dtype, workload), sub-group by steps, chunk
+        # at max_batch. Grouping is order-preserving within a bucket so
+        # the span/ticket bookkeeping reads naturally in traces. A heat
+        # board and a life board of the same shape never share a stack.
         buckets: dict[tuple, list[_Request]] = {}
         for r in self._queue:
-            buckets.setdefault((r.board.shape, r.board.dtype.str), []).append(r)
+            buckets.setdefault(
+                (r.board.shape, r.board.dtype.str, r.workload), []).append(r)
 
-        for (shape, _dtype), reqs in buckets.items():
+        for (shape, _dtype, workload), reqs in buckets.items():
             by_steps: dict[int, list[_Request]] = {}
             for r in reqs:
                 by_steps.setdefault(r.steps, []).append(r)
-            width = pallas_life.batch_slice_width(shape, on_tpu=on_tpu)
+            # Bit-plane slice rounding is a Life binary-board layout;
+            # other stencil workloads pad on the plain pow2 ladder.
+            width = (pallas_life.batch_slice_width(shape, on_tpu=on_tpu)
+                     if workload == "life" else None)
             for steps, group in by_steps.items():
                 for lo in range(0, len(group), self.max_batch):
                     chunk = group[lo:lo + self.max_batch]
@@ -212,15 +237,25 @@ class ShapeBucketBatcher:
                     stack = np.zeros((padded, *shape), dtype=chunk[0].board.dtype)
                     for i, r in enumerate(chunk):
                         stack[i] = r.board
-                    path = pallas_life.native_path_batch(
-                        stack.shape, on_tpu=on_tpu)
+                    if workload == "life":
+                        path = pallas_life.native_path_batch(
+                            stack.shape, on_tpu=on_tpu)
+                    else:
+                        path = f"stencil:{workload}"
                     with trace.span(
-                        "serve.batch", shape=f"{shape[0]}x{shape[1]}",
+                        "serve.batch", shape=f"{shape[-2]}x{shape[-1]}",
                         steps=steps, requests=len(chunk), padded=padded,
-                        path=path,
+                        path=path, workload=workload,
                     ) as sp:
-                        out = pallas_life.life_run_vmem_batch(
-                            jnp.asarray(stack), steps)
+                        if workload == "life":
+                            out = pallas_life.life_run_vmem_batch(
+                                jnp.asarray(stack), steps)
+                        else:
+                            from mpi_and_open_mp_tpu import stencils
+
+                            out = stencils.run_roll_batch(
+                                stencils.get(workload), jnp.asarray(stack),
+                                steps)
                         sp.anchor(out)
                     host = np.asarray(out)[: len(chunk)]
                     for i, r in enumerate(chunk):

@@ -127,7 +127,8 @@ class ServingDaemon:
     # -- intake ------------------------------------------------------------
 
     def submit(self, board: np.ndarray, steps: int,
-               session: str | None = None) -> Ticket:
+               session: str | None = None,
+               workload: str = "life") -> Ticket:
         """Admit (or reject-with-reason) one request; see
         :meth:`ServeQueue.submit`. An ADMITTED ticket is journaled before
         this returns — under ``every-record`` fsync the caller's ack
@@ -135,8 +136,11 @@ class ServingDaemon:
         Door-shed tickets are terminal before they exist anywhere worth
         replaying, so they never touch the journal. ``session`` is the
         fleet affinity key; it rides the journal so a router can re-home
-        a dead worker's pending set by consistent hash."""
-        t = self.queue.submit(board, steps, self._clock(), session=session)
+        a dead worker's pending set by consistent hash. ``workload``
+        names the stencil rule (``stencils.get``) — it buckets the
+        dispatch, picks the engine ladder, and rides the journal."""
+        t = self.queue.submit(board, steps, self._clock(), session=session,
+                              workload=workload)
         if t.state == PENDING and self._wal is not None:
             # Instrumented crash site: admitted in memory, journal record
             # not yet written. A death here loses a ticket whose submit()
@@ -144,7 +148,8 @@ class ServingDaemon:
             # zero-ACKED-loss bound is intact.
             if chaos.crash_armed("post-admit"):
                 chaos.crash_now()
-            self._wal.admit(t.id, t.board, t.steps, session=t.session)
+            self._wal.admit(t.id, t.board, t.steps, session=t.session,
+                            workload=t.workload)
         return t
 
     # -- device-resident sessions -------------------------------------------
@@ -322,6 +327,7 @@ class ServingDaemon:
         entries = [
             {"board": np.asarray(t.board), "steps": t.steps,
              "session": t.session, "wall": wall,
+             "workload": t.workload,
              "queued_s": t.queued_before_s + (now - t.submitted_at)}
             for t in live
         ]
@@ -347,10 +353,12 @@ class ServingDaemon:
                 queued += max(0.0, wall_now - wall)
             t = self.queue.restore_ticket(
                 e["board"], e["steps"], now, queued_s=queued,
-                session=e.get("session"))
+                session=e.get("session"),
+                workload=str(e.get("workload", "life")))
             if self._wal is not None:
                 self._wal.admit(t.id, t.board, t.steps,
-                                queued_s=queued, session=t.session)
+                                queued_s=queued, session=t.session,
+                                workload=t.workload)
             out.append(t)
         return out
 
@@ -436,7 +444,8 @@ class ServingDaemon:
                         queued += max(0.0, wall_now - wall)
                     daemon.queue.restore_ticket(
                         entry["board"], entry["steps"], now, queued_s=queued,
-                        session=entry.get("session"))
+                        session=entry.get("session"),
+                        workload=str(entry.get("workload", "life")))
                 # Re-materialize the device pool BEFORE rotating the
                 # journal: rotation snapshots the session log, so the
                 # log must already hold every replayed session.
@@ -479,8 +488,12 @@ class ServingDaemon:
         returns (and records in ``detail``) the warm-pass stats."""
         if self._aot is None:
             return None
+        # The durable program store holds LIFE bucket executables only —
+        # other stencil workloads trace per process (their rung ladder
+        # has no aot top rung), so they contribute nothing to warm.
         boards = {(t.board.shape, str(np.asarray(t.board).dtype))
-                  for t in self.queue.pending() if t.board is not None}
+                  for t in self.queue.pending()
+                  if t.board is not None and t.workload == "life"}
         if not boards:
             return None
         summary = self._aot.warm(sorted(boards), self.policy.max_batch)
@@ -550,7 +563,7 @@ class ServingDaemon:
         wall = time.time()
         entries = [
             {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
-             "wall": wall, "session": t.session,
+             "wall": wall, "session": t.session, "workload": t.workload,
              "queued_s": t.queued_before_s + (now - t.submitted_at)}
             for t in self.queue.pending() if t.board is not None
         ]
@@ -596,14 +609,24 @@ class ServingDaemon:
         cls = SimulatedPreemption if simulated else Preempted
         raise cls(self._batches, checkpoint=path, signum=signum)
 
-    def _validator(self, stack_shape: tuple):
-        def ok(out) -> bool:
-            a = np.asarray(out)
-            return a.shape == stack_shape and bool((a <= 1).all())
+    def _validator(self, stack_shape: tuple, spec=None):
+        """Sanity gate every rung's output passes before it resolves
+        tickets. Life keeps the historic binary-board check; other
+        stencil workloads validate through the spec's own invariant
+        (state range for automata, finiteness for float fields)."""
+        if spec is None or spec.name == "life":
+            def ok(out) -> bool:
+                a = np.asarray(out)
+                return a.shape == stack_shape and bool((a <= 1).all())
+        else:
+            def ok(out) -> bool:
+                a = np.asarray(out)
+                return (a.shape == stack_shape
+                        and all(spec.valid_board(b) for b in a))
 
         return ok
 
-    def _engines(self, stack: np.ndarray, steps: int):
+    def _engines(self, stack: np.ndarray, steps: int, spec=None):
         """The graceful-degradation ladder for one padded chunk, ranked:
         the durable AOT executable (when a cache is attached — a
         deserialized ``jax.export`` program that runs with ZERO
@@ -619,10 +642,38 @@ class ServingDaemon:
         ``aot:<path>:corrupt`` / ``aot:<path>:stale`` when this dispatch
         had to build fresh (a bad artifact was quarantined first).
         Fallback engines run under ``chaos.suppressed()`` so a recovery
-        dispatch cannot be re-failed by the fault that triggered it."""
+        dispatch cannot be re-failed by the fault that triggered it.
+
+        Non-life stencil workloads (``spec`` given and not life) get a
+        two-rung ladder instead — the spec-generated vmapped roll engine
+        (``batch:stencil:<name>``) over the spec's own NumPy oracle —
+        because the bit-packed/bit-sliced machinery below is a Life
+        binary-board specialization by construction."""
         import jax
 
         from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+        if spec is not None and spec.name != "life":
+            from mpi_and_open_mp_tpu import stencils
+
+            def stencil_native():
+                import jax.numpy as jnp
+
+                if chaos.take_serve_fault():
+                    raise RuntimeError(
+                        "chaos: injected serve dispatch fault")
+                return np.asarray(stencils.run_roll_batch(
+                    spec, jnp.asarray(stack), steps))
+
+            def stencil_oracle():
+                with chaos.suppressed():
+                    out = np.array(stack, copy=True)
+                    for b in range(out.shape[0]):
+                        out[b] = stencils.oracle_run(spec, out[b], steps)
+                    return out
+
+            return [(f"batch:stencil:{spec.name}", stencil_native),
+                    ("oracle", stencil_oracle)]
 
         on_tpu = jax.default_backend() == "tpu"
         path = pallas_life.native_path_batch(stack.shape, on_tpu=on_tpu)
@@ -751,6 +802,9 @@ class ServingDaemon:
         # it on a survivor is idempotent).
         if chaos.kill_worker_armed(self.worker_index):
             chaos.crash_now()
+        from mpi_and_open_mp_tpu import stencils
+
+        spec = stencils.get(live[0].workload)
         shape = live[0].board.shape
         steps = live[0].steps
         padded = bucket_batch_size(
@@ -759,8 +813,13 @@ class ServingDaemon:
         stack = np.zeros((padded, *shape), dtype=live[0].board.dtype)
         for i, t in enumerate(live):
             stack[i] = t.board
-        engines = self._engines(stack, steps)
-        validator = self._validator(stack.shape)
+        # Life keeps the historic two-arg call (its ladder never needs
+        # the spec); non-life workloads thread theirs through.
+        if spec.name == "life":
+            engines = self._engines(stack, steps)
+        else:
+            engines = self._engines(stack, steps, spec)
+        validator = self._validator(stack.shape, spec)
         # One jittered backoff schedule per chunk, seeded off the lead
         # ticket so concurrent requeued daemons desynchronise while any
         # single run stays reproducible.
@@ -775,9 +834,9 @@ class ServingDaemon:
                 self._sleep(delay)
             try:
                 with trace.span(
-                    "serve.dispatch", shape=f"{shape[0]}x{shape[1]}",
+                    "serve.dispatch", shape=f"{shape[-2]}x{shape[-1]}",
                     steps=steps, requests=len(live), padded=padded,
-                    attempt=attempt,
+                    workload=spec.name, attempt=attempt,
                 ):
                     out, stamp, _notes = guards.with_fallback(
                         engines, validator=validator)
@@ -900,6 +959,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", default="4,8", metavar="K",
                    help="comma-separated step counts, cycled (default "
                    "%(default)s)")
+    p.add_argument("--workload", default="life", metavar="NAME",
+                   help="stencil workload for the burst (a registered "
+                   "stencils name: life, heat, gray_scott, wireworld; "
+                   "default %(default)s) — boards come from the spec's "
+                   "own seeder and dispatch through the spec's engine "
+                   "ladder")
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-depth", type=int, default=4096)
     p.add_argument("--max-wait", type=float, default=0.02, metavar="S",
@@ -975,25 +1040,27 @@ def _parse_shapes(spec: str) -> list[tuple[int, int]]:
 
 
 def _burst(daemon: ServingDaemon, args) -> None:
+    from mpi_and_open_mp_tpu import stencils
+
+    spec = stencils.get(args.workload)
     shapes = _parse_shapes(args.shapes)
     steps = [int(s) for s in args.steps.split(",")]
     rng = np.random.default_rng(args.seed)
     for i in range(args.requests):
         ny, nx = shapes[i % len(shapes)]
-        board = (rng.random((ny, nx)) < 0.3).astype(np.uint8)
-        daemon.submit(board, steps[i % len(steps)])
+        daemon.submit(spec.init(rng, (ny, nx)), steps[i % len(steps)],
+                      workload=spec.name)
 
 
 def _verify(daemon: ServingDaemon) -> bool:
-    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu import stencils
 
     for t in daemon.queue.tickets():
         if t.state != DONE:
             continue
-        ref = np.asarray(t.board).copy()
-        for _ in range(t.steps):
-            ref = life_step_numpy(ref)
-        if not np.array_equal(t.result, ref):
+        spec = stencils.get(getattr(t, "workload", "life"))
+        ref = stencils.oracle_run(spec, np.asarray(t.board), t.steps)
+        if not stencils.parity_ok(spec, t.result, ref):
             return False
     return True
 
@@ -1023,7 +1090,8 @@ def main(argv=None) -> int:
         max_retries=args.retries, backoff_base_s=backoff_base,
         backoff_cap_s=backoff_cap, backoff_jitter=backoff_jitter,
         seed=args.seed)
-    rec: dict = {"daemon": "serve", "resume": bool(args.resume)}
+    rec: dict = {"daemon": "serve", "resume": bool(args.resume),
+                 "workload": args.workload}
     if aot is not None:
         rec["aot_cache"] = rec_aot_cache
     try:
@@ -1039,10 +1107,11 @@ def main(argv=None) -> int:
                 policy, checkpoint_path=args.checkpoint,
                 wal_path=args.wal, wal_fsync=args.wal_fsync,
                 aot_cache=aot)
-        if aot is not None and args.requests > 0:
+        if aot is not None and args.requests > 0 and args.workload == "life":
             # Preload for the incoming burst too (the resume preload
             # covered only already-pending shapes): every bucket program
             # the burst can need is resident before the first dispatch.
+            # Life only — the store holds life bucket executables.
             rec["aot_warm"] = aot.warm(
                 [(s, "uint8") for s in _parse_shapes(args.shapes)],
                 policy.max_batch)

@@ -10,10 +10,12 @@ counts, submission order) through the crash-atomic CRC state checkpoint
 them unconditionally — admission control applies at the door, not to
 requests the daemon already accepted.
 
-Buckets key on ``(shape, dtype, steps)`` — one bucket is one compiled
-program's worth of same-shape work (steps being a runtime scalar, the
-split by steps exists because all boards of a stack advance together,
-not for compilation). Deadline bookkeeping lives here (oldest pending
+Buckets key on ``(shape, dtype, steps, workload)`` — one bucket is one
+compiled program's worth of same-shape same-rule work (steps being a
+runtime scalar, the split by steps exists because all boards of a stack
+advance together, not for compilation; the split by workload exists
+because a heat board and a life board of one shape run different
+programs). Deadline bookkeeping lives here (oldest pending
 ticket per bucket); the policy decides when a bucket is due, the daemon
 dispatches it.
 """
@@ -64,6 +66,10 @@ class Ticket:
     #: Device-resident handle (``serve.pool.Handle``) for a session step
     #: ticket. Set iff ``board`` is ``None``.
     handle: object | None = None
+    #: Stencil workload name (``stencils.get``): which rule advances this
+    #: board. Part of the bucket key — a heat board and a life board of
+    #: the same shape must never share a dispatch.
+    workload: str = "life"
 
     @property
     def bucket_key(self) -> tuple:
@@ -72,7 +78,8 @@ class Ticket:
             # advanced by the SAME donated dispatch, so slab-mates with
             # equal step counts coalesce into one program invocation.
             return ("pool", self.handle.slab, self.steps)
-        return (self.board.shape, self.board.dtype.str, self.steps)
+        return (self.board.shape, self.board.dtype.str, self.steps,
+                self.workload)
 
     @property
     def latency_s(self) -> float | None:
@@ -96,21 +103,34 @@ class ServeQueue:
     # -- intake ------------------------------------------------------------
 
     def submit(self, board: np.ndarray, steps: int, now: float,
-               session: str | None = None) -> Ticket:
+               session: str | None = None,
+               workload: str = "life") -> Ticket:
         """Admit or reject one request; ALWAYS returns a ticket. A
         rejected ticket is already terminal (``SHED`` with the admission
-        reason) so callers account for every submission the same way."""
+        reason) so callers account for every submission the same way.
+        ``workload`` names the stencil rule (``stencils.get``); the
+        board must match the spec's layout — 2D, or channels-leading 3D
+        for multi-channel rules like gray_scott."""
+        from mpi_and_open_mp_tpu import stencils
         from mpi_and_open_mp_tpu.obs import metrics, trace
 
+        try:
+            spec = stencils.get(workload)
+        except KeyError as e:
+            raise ValueError(str(e)) from None
         board = np.asarray(board)
-        if board.ndim != 2:
+        if (board.ndim < 2
+                or board.shape != spec.board_shape(*board.shape[-2:])):
+            want = ("3D (channels, ny, nx)" if spec.channels > 1
+                    else "2D (ny, nx)")
             raise ValueError(
-                f"submit: one 2D board per request, got shape {board.shape}")
+                f"submit: workload {workload!r} wants one {want} board "
+                f"per request, got shape {board.shape}")
         steps = int(steps)
         if steps < 0:
             raise ValueError(f"submit: steps must be >= 0, got {steps}")
         t = Ticket(self._next_ticket, board, steps, float(now),
-                   session=session)
+                   session=session, workload=str(workload))
         self._next_ticket += 1
         counts = self._bucket_counts()
         counts[t.bucket_key] = counts.get(t.bucket_key, 0) + 1
@@ -125,8 +145,8 @@ class ServeQueue:
         else:
             metrics.inc("serve.admitted")
             trace.event("serve.admit", ticket=t.id,
-                        shape=f"{board.shape[0]}x{board.shape[1]}",
-                        steps=steps)
+                        shape=f"{board.shape[-2]}x{board.shape[-1]}",
+                        steps=steps, workload=t.workload)
         return t
 
     def submit_session(self, session: str, handle, steps: int,
@@ -159,7 +179,8 @@ class ServeQueue:
 
     def restore_ticket(self, board: np.ndarray, steps: int,
                        now: float, queued_s: float = 0.0,
-                       session: str | None = None) -> Ticket:
+                       session: str | None = None,
+                       workload: str = "life") -> Ticket:
         """Re-admit one drained ticket from a checkpoint — NO admission
         gate (it was already admitted once; dropping it now would break
         the never-lose-a-ticket contract). The deadline clock restarts at
@@ -170,7 +191,8 @@ class ServeQueue:
 
         t = Ticket(self._next_ticket, np.asarray(board), int(steps),
                    float(now), resumed=True, session=session,
-                   queued_before_s=float(queued_s))
+                   queued_before_s=float(queued_s),
+                   workload=str(workload))
         self._next_ticket += 1
         self._tickets[t.id] = t
         metrics.inc("serve.requests")
@@ -202,7 +224,10 @@ class ServeQueue:
         (``ops.pallas_life.batch_slice_width``) so admission's
         padding-waste projection matches the actual dispatch. Cached per
         shape — the gate is pure arithmetic on (ny, nx) plus one env
-        flag, both stable for the process lifetime."""
+        flag, both stable for the process lifetime. Non-life buckets
+        dispatch the generic stencil engine (no slice-width rounding)."""
+        if bucket_key[-1] != "life":
+            return None
         shape = bucket_key[0]
         try:
             return self._width_cache[shape]
@@ -303,7 +328,7 @@ class ServeQueue:
             "next_ticket": self._next_ticket,
             "pending": [
                 {"id": t.id, "board": np.asarray(t.board), "steps": t.steps,
-                 "session": t.session,
+                 "session": t.session, "workload": t.workload,
                  "queued_s": (t.queued_before_s
                               + (float(now) - t.submitted_at
                                  if now is not None else 0.0))}
@@ -335,5 +360,6 @@ class ServeQueue:
             out.append(self.restore_ticket(
                 board, steps, now,
                 queued_s=float(item.get("queued_s", 0.0)),
-                session=item.get("session")))
+                session=item.get("session"),
+                workload=str(item.get("workload", "life"))))
         return out

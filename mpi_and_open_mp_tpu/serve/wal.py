@@ -22,14 +22,16 @@ File format (``momp-serve-wal/1``)::
 
 Record types and what :func:`replay` does with them:
 
-``ADMIT {id, board, steps, wall, queued_s[, session]}``
+``ADMIT {id, board, steps, wall, queued_s[, session][, workload]}``
     Ticket enters the pending set. ``wall`` is ``time.time()`` at the
     append (monotonic clocks don't survive a process boundary; wall time
     lets the resuming process carry true queued seconds forward).
     ``session`` is the optional fleet affinity key — the router re-homes
     a dead worker's pending set by consistent-hashing it, so the key
     must survive the journal round trip (absent in pre-fleet journals;
-    replay surfaces ``None``).
+    replay surfaces ``None``). ``workload`` names the stencil rule
+    (absent in pre-stencil journals; replay surfaces ``"life"`` — which
+    is exactly what those journals ran).
 ``DISPATCH {ids}``
     A chunk went to the engines. Pending membership is unchanged — a
     ``DISPATCH`` without a later ``RESOLVE``/``SHED`` covering its ids
@@ -264,6 +266,8 @@ def replay(path: str | os.PathLike) -> WALReplay:
                 "wall": float(rec.get("wall", 0.0)),
                 "queued_s": float(rec.get("queued_s", 0.0)),
                 "session": rec.get("session"),
+                # Pre-stencil journals carry no workload: life, exactly.
+                "workload": str(rec.get("workload", "life")),
             }
         elif rtype == "DISPATCH":
             for tid in rec["ids"]:
@@ -344,6 +348,7 @@ def replay(path: str | os.PathLike) -> WALReplay:
                     "wall": float(entry.get("wall", 0.0)),
                     "queued_s": float(entry.get("queued_s", 0.0)),
                     "session": entry.get("session"),
+                    "workload": str(entry.get("workload", "life")),
                 }
             for entry in snap.get("pool", []):
                 sid = str(entry["id"])
@@ -415,13 +420,15 @@ class TicketWAL:
 
     def admit(self, ticket_id: int, board, steps: int, *,
               wall: float | None = None, queued_s: float = 0.0,
-              session: str | None = None) -> None:
+              session: str | None = None,
+              workload: str = "life") -> None:
         self._append("ADMIT", {
             "id": int(ticket_id), "board": np.asarray(board),
             "steps": int(steps),
             "wall": time.time() if wall is None else float(wall),
             "queued_s": float(queued_s),
             "session": session,
+            "workload": str(workload),
         })
 
     def dispatch_begin(self, ticket_ids: list[int]) -> None:
@@ -478,6 +485,7 @@ class TicketWAL:
             "steps": int(e["steps"]), "wall": float(e.get("wall", 0.0)),
             "queued_s": float(e.get("queued_s", 0.0)),
             "session": e.get("session"),
+            "workload": str(e.get("workload", "life")),
         } for e in pending_entries]
         pool = [{
             "id": str(s["id"]), "board": np.asarray(s["board"]),

@@ -1,0 +1,29 @@
+"""Stencil spec subsystem: every rule a servable workload.
+
+See ``stencils.spec`` (the declarative :class:`StencilSpec` + registry),
+``stencils.engine`` (spec-generated roll / padded / oracle steps), and
+``stencils.sparse`` (the active-tile engine for mostly-dead boards).
+"""
+
+from .engine import (  # noqa: F401
+    aggregate_roll,
+    offsets,
+    oracle_run,
+    parity_ok,
+    run_roll,
+    run_roll_batch,
+    step_numpy,
+    step_padded,
+    step_roll,
+)
+from .spec import (  # noqa: F401
+    GRAY_SCOTT,
+    HEAT,
+    LIFE,
+    WIREWORLD,
+    StencilSpec,
+    get,
+    names,
+    register,
+)
+from .sparse import ActiveTileEngine  # noqa: F401

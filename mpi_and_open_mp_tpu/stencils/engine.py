@@ -1,0 +1,191 @@
+"""Generic stencil step generators: one spec, every fast path.
+
+Every path here derives from the SAME offset table (nonzero ``weights``
+entries in row-major order), so the NumPy oracle and the jitted fast
+paths perform the aggregation in the same order — bit-exact for integer
+dtypes, reproducibly close for floats (XLA may still fuse/reassociate,
+which is why float parity gates use a tight ``allclose`` instead of
+``array_equal``; see ``tests/test_stencils.py``).
+
+Paths:
+
+* :func:`step_roll` — torus step via shifts on the last two axes
+  (channels ride the leading axis untouched). The radius-1 all-ones box
+  (Life's neighbourhood) takes the separable row-sum/col-sum form —
+  exactly ``ops.life_ops.life_step_roll``'s shape, 4 shifts instead
+  of 8.
+* :func:`step_padded` — interior step over a board carrying a
+  ``radius``-wide halo on the last two axes; pure slicing, no wrap, so
+  it drops straight into shard-local halo blocks and Pallas kernels.
+* :func:`step_numpy` — the derived NumPy oracle (plain per-offset roll
+  loop; specs may pin an independent ``oracle_step`` instead).
+* :func:`run_roll` — jitted ``fori_loop`` chain of :func:`step_roll`
+  for benchmarking (n is a runtime scalar: one compile per board shape).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .spec import BOX3, StencilSpec
+
+
+@functools.lru_cache(maxsize=None)
+def offsets(spec: StencilSpec) -> tuple:
+    """Nonzero ``(dy, dx, weight)`` neighbour displacements, row-major.
+    A neighbour at displacement ``(dy, dx)`` contributes
+    ``weight * board[y + dy, x + dx]`` to the aggregate."""
+    r = spec.radius
+    out = []
+    for j, row in enumerate(spec.weights):
+        for i, w in enumerate(row):
+            if w:
+                out.append((j - r, i - r, w))
+    return tuple(out)
+
+
+def _is_box3(spec: StencilSpec) -> bool:
+    return spec.radius == 1 and spec.weights == BOX3
+
+
+def _shift(field, dy, dx, xp):
+    # roll(-dy) moves the value at y+dy into row y (and likewise for x).
+    out = field
+    if dy:
+        out = xp.roll(out, -dy, axis=-2)
+    if dx:
+        out = xp.roll(out, -dx, axis=-1)
+    return out
+
+
+def aggregate_roll(spec: StencilSpec, board, xp):
+    """The weighted neighbour sum of a torus board (last two axes)."""
+    field = board if spec.pre is None else spec.pre(board, xp)
+    if _is_box3(spec):
+        rows = field + xp.roll(field, 1, axis=-2) + xp.roll(field, -1, axis=-2)
+        return (rows + xp.roll(rows, 1, axis=-1)
+                + xp.roll(rows, -1, axis=-1) - field)
+    agg = None
+    for dy, dx, w in offsets(spec):
+        term = _shift(field, dy, dx, xp)
+        if w != 1:
+            term = term * w
+        agg = term if agg is None else agg + term
+    return agg
+
+
+def step_roll(spec: StencilSpec, board, xp=None):
+    """One torus step via rolls; works under numpy or jax.numpy."""
+    if xp is None:
+        import jax.numpy as xp  # noqa: F811
+    return spec.update(board, aggregate_roll(spec, board, xp), xp)
+
+
+def step_padded(spec: StencilSpec, padded, xp=None):
+    """One interior step over a halo-padded block.
+
+    ``padded`` carries a ``spec.radius``-deep halo on the last two axes;
+    the result is the updated interior (halo trimmed). Slicing only —
+    usable inside Pallas kernels and shard_map bodies unchanged.
+    """
+    if xp is None:
+        import jax.numpy as xp  # noqa: F811
+    r = spec.radius
+    h = padded.shape[-2] - 2 * r
+    w = padded.shape[-1] - 2 * r
+    field = padded if spec.pre is None else spec.pre(padded, xp)
+    center = padded[..., r:r + h, r:r + w]
+    if _is_box3(spec):
+        rows = (field[..., 0:h, :] + field[..., 1:h + 1, :]
+                + field[..., 2:h + 2, :])
+        agg = (rows[..., 0:w] + rows[..., 1:w + 1] + rows[..., 2:w + 2]
+               - field[..., 1:h + 1, 1:w + 1])
+    else:
+        agg = None
+        for dy, dx, wt in offsets(spec):
+            term = field[..., r + dy:r + dy + h, r + dx:r + dx + w]
+            if wt != 1:
+                term = term * wt
+            agg = term if agg is None else agg + term
+    return spec.update(center, agg, xp)
+
+
+def step_numpy(spec: StencilSpec, board: np.ndarray) -> np.ndarray:
+    """The spec's NumPy oracle step (independent ``oracle_step`` when
+    the spec pins one, else the derived per-offset roll loop)."""
+    board = np.asarray(board, dtype=spec.np_dtype)
+    if spec.oracle_step is not None:
+        return spec.oracle_step(board)
+    field = board if spec.pre is None else spec.pre(board, np)
+    agg = None
+    for dy, dx, w in offsets(spec):
+        term = _shift(field, dy, dx, np)
+        if w != 1:
+            term = term * w
+        agg = term if agg is None else agg + term
+    return np.asarray(spec.update(board, agg, np), dtype=spec.np_dtype)
+
+
+def oracle_run(spec: StencilSpec, board: np.ndarray, n: int) -> np.ndarray:
+    out = np.asarray(board, dtype=spec.np_dtype)
+    for _ in range(int(n)):
+        out = step_numpy(spec, out)
+    return out
+
+
+def parity_ok(spec: StencilSpec, got, want, *, rtol=1e-5, atol=1e-6) -> bool:
+    """The per-spec parity predicate: exact for integer dtypes, tight
+    allclose for floats (XLA vs NumPy may reassociate float sums)."""
+    got = np.asarray(got)
+    want = np.asarray(want)
+    if got.shape != want.shape:
+        return False
+    if spec.is_float:
+        return bool(np.allclose(got, want, rtol=rtol, atol=atol))
+    return bool(np.array_equal(got, want))
+
+
+@functools.lru_cache(maxsize=None)
+def _run_roll_jit(spec: StencilSpec):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(board, n):
+        return lax.fori_loop(
+            0, n, lambda _, b: step_roll(spec, b, jnp), board)
+
+    return jax.jit(run)
+
+
+def run_roll(spec: StencilSpec, board, n: int):
+    """``n`` chained :func:`step_roll` steps as ONE dispatch (jitted
+    fori_loop; ``n`` is a runtime scalar so run-length differencing
+    reuses a single compiled program per board shape)."""
+    return _run_roll_jit(spec)(board, n)
+
+
+@functools.lru_cache(maxsize=None)
+def _run_roll_batch_jit(spec: StencilSpec):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # vmap over the leading stack axis so multi-channel rules (which
+    # index channels as center[0]/center[1]) see one board at a time.
+    vstep = jax.vmap(lambda b: step_roll(spec, b, jnp))
+
+    def run(stack, n):
+        return lax.fori_loop(0, n, lambda _, s: vstep(s), stack)
+
+    return jax.jit(run)
+
+
+def run_roll_batch(spec: StencilSpec, stack, n: int):
+    """``n`` chained torus steps of a STACK of boards as one dispatch —
+    the generic serve-layer batch engine (``n`` is a runtime scalar,
+    matching the life batch engines' calling convention, so a bucket
+    compiles once per stack shape)."""
+    return _run_roll_batch_jit(spec)(stack, n)

@@ -1,0 +1,241 @@
+"""Declarative stencil specifications + the workload registry.
+
+The reference repo is ONE hard-coded rule (Conway's Life on a torus);
+every fast path in this repo — roll, padded-shard, Pallas VMEM, halo
+exchange — was welded to it. A :class:`StencilSpec` factors the rule
+out: neighborhood weights (radius), cell dtype, channel count, boundary,
+and a pure ``update(center, neighbor_agg, xp) -> next`` function. The
+generic engine (``stencils.engine``) generates the roll / padded / Pallas
+step from any spec; the NumPy oracle for parity gating comes from the
+same offset table (or, for ``life``, the historical independent oracle
+``ops.life_ops.life_step_numpy`` — the generic path must stay bit-exact
+against it, not against itself).
+
+``update`` receives ``xp`` — ``numpy`` or ``jax.numpy`` — so one rule
+body serves both the oracle and every jitted fast path (``xp.stack``,
+``xp.where`` and friends resolve to whichever backend the engine is
+driving). Specs are frozen and hashable so jitted step builders can be
+cached per spec.
+
+Registered workloads (``get(name)`` / ``names()``):
+
+* ``life`` — the existing semantics, bit-exact (uint8, radius-1 box).
+* ``heat`` — float32 5-point diffusion (explicit Euler, alpha=0.1).
+* ``gray_scott`` — two-channel float32 reaction-diffusion.
+* ``wireworld`` — 4-state automaton (empty/head/tail/conductor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+#: Radius-1 all-neighbour box (Moore neighbourhood), center zero.
+BOX3 = ((1, 1, 1), (1, 0, 1), (1, 1, 1))
+#: Radius-1 5-point cross (von Neumann), center zero.
+CROSS3 = ((0, 1, 0), (1, 0, 1), (0, 1, 0))
+
+
+@dataclass(frozen=True)
+class StencilSpec:
+    """One servable stencil workload.
+
+    ``weights`` is a ``(2*radius+1)``-square nested tuple with a ZERO
+    center — the engine aggregates ``sum(w * neighbour)`` over nonzero
+    entries in row-major order (fixed order: bit-exact for integer
+    dtypes, reproducible for floats). ``pre(board, xp)`` optionally maps
+    the board to the field being aggregated (wireworld counts electron
+    HEADS, not raw state values). ``update(center, agg, xp)`` is the
+    pure rule; ``xp`` is ``numpy`` or ``jax.numpy``. Multi-channel
+    boards carry channels on the LEADING axis — the engine only ever
+    shifts the last two axes, so channels broadcast for free.
+    """
+
+    name: str
+    radius: int
+    dtype: str
+    weights: tuple
+    update: Callable
+    channels: int = 1
+    boundary: str = "torus"
+    pre: Callable | None = None
+    init: Callable | None = None
+    states: int | None = None
+    #: Independent NumPy oracle; None means "derive from the offset
+    #: table" (``engine.step_numpy``). ``life`` pins the historical
+    #: oracle so the generic path is gated against the original truth.
+    oracle_step: Callable | None = None
+    extra: tuple = field(default=())
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(self.dtype)
+
+    @property
+    def is_float(self) -> bool:
+        return np.issubdtype(self.np_dtype, np.floating)
+
+    def board_shape(self, ny: int, nx: int) -> tuple:
+        """Full board shape for an ``ny x nx`` grid (channels leading)."""
+        return (self.channels, ny, nx) if self.channels > 1 else (ny, nx)
+
+    def valid_board(self, board: np.ndarray) -> bool:
+        """Domain check used by the chaos/consistency guards: automata
+        states must stay in range, float fields must stay finite."""
+        board = np.asarray(board)
+        if self.states is not None:
+            return bool(np.isin(board, np.arange(self.states)).all())
+        if self.is_float:
+            return bool(np.isfinite(board).all())
+        return True
+
+
+# --------------------------------------------------------------------------
+# Rule bodies (module-level so specs stay hashable + picklable).
+
+def _life_update(center, agg, xp):
+    # Exactly ops.life_ops.life_rule: birth on 3, survival on 2.
+    return ((agg == 3) | ((agg == 2) & (center == 1))).astype(center.dtype)
+
+
+HEAT_ALPHA = 0.1
+
+
+def _heat_update(center, agg, xp):
+    # Explicit Euler 5-point diffusion; agg is the cross sum, so
+    # (agg - 4c) is the discrete Laplacian.
+    return (center + HEAT_ALPHA * (agg - 4 * center)).astype(center.dtype)
+
+
+GS_DU, GS_DV, GS_F, GS_K, GS_DT = 0.16, 0.08, 0.04, 0.06, 1.0
+
+
+def _gray_scott_update(center, agg, xp):
+    # center/agg: (2, ny, nx) — channel 0 is U, channel 1 is V; agg is
+    # the per-channel 5-point cross sum, so agg - 4*center is the
+    # Laplacian of each channel.
+    u, v = center[0], center[1]
+    lu = agg[0] - 4 * u
+    lv = agg[1] - 4 * v
+    uvv = u * v * v
+    un = u + (GS_DU * lu - uvv + GS_F * (1 - u)) * GS_DT
+    vn = v + (GS_DV * lv + uvv - (GS_F + GS_K) * v) * GS_DT
+    return xp.stack([un, vn]).astype(center.dtype)
+
+
+def _wireworld_pre(board, xp):
+    # Aggregate counts electron HEADS only.
+    return (board == 1).astype(board.dtype)
+
+
+def _wireworld_update(center, agg, xp):
+    # 0 empty -> empty, 1 head -> tail(2), 2 tail -> conductor(3),
+    # 3 conductor -> head(1) iff 1 or 2 head neighbours, else stays.
+    is_head = center == 1
+    is_tail = center == 2
+    is_cond = center == 3
+    excite = (agg == 1) | (agg == 2)
+    nxt = is_head * 2 + is_tail * 3 + is_cond * (3 - 2 * excite)
+    return nxt.astype(center.dtype)
+
+
+# --------------------------------------------------------------------------
+# Initial-board builders (NumPy, host-side; rng is np.random.Generator).
+
+def _life_init(rng, shape):
+    ny, nx = shape
+    return (rng.random((ny, nx)) < 0.33).astype(np.uint8)
+
+
+def _heat_init(rng, shape):
+    ny, nx = shape
+    return rng.random((ny, nx)).astype(np.float32)
+
+
+def _gray_scott_init(rng, shape):
+    ny, nx = shape
+    u = np.ones((ny, nx), np.float32)
+    v = np.zeros((ny, nx), np.float32)
+    # A few perturbation squares kick off the pattern; the bulk stays
+    # at the trivial (U=1, V=0) fixed point.
+    for _ in range(max(1, (ny * nx) // 4096)):
+        cy = int(rng.integers(0, ny))
+        cx = int(rng.integers(0, nx))
+        s = 4
+        ys = np.arange(cy - s, cy + s) % ny
+        xs = np.arange(cx - s, cx + s) % nx
+        u[np.ix_(ys, xs)] = 0.5
+        v[np.ix_(ys, xs)] = 0.25
+    return np.stack([u, v])
+
+
+def _wireworld_init(rng, shape):
+    ny, nx = shape
+    # Random mix biased toward empty/conductor with sparse head/tail —
+    # enough live signal for parity fuzz without hand-drawing circuits.
+    return rng.choice(
+        np.arange(4, dtype=np.uint8), size=(ny, nx),
+        p=[0.55, 0.05, 0.05, 0.35]).astype(np.uint8)
+
+
+def _life_oracle(board):
+    from mpi_and_open_mp_tpu.ops import life_ops
+
+    return life_ops.life_step_numpy(board)
+
+
+# --------------------------------------------------------------------------
+# Registry.
+
+_REGISTRY: dict[str, StencilSpec] = {}
+
+
+def register(spec: StencilSpec) -> StencilSpec:
+    if spec.name in _REGISTRY:
+        raise ValueError(f"stencil {spec.name!r} already registered")
+    side = 2 * spec.radius + 1
+    w = np.asarray(spec.weights)
+    if w.shape != (side, side):
+        raise ValueError(
+            f"stencil {spec.name!r}: weights shape {w.shape} != "
+            f"({side}, {side}) for radius {spec.radius}")
+    if w[spec.radius, spec.radius] != 0:
+        raise ValueError(
+            f"stencil {spec.name!r}: weights center must be 0 (the rule "
+            "sees the center via the `center` argument)")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> StencilSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown stencil workload {name!r}; registered: "
+            f"{', '.join(sorted(_REGISTRY))}") from None
+
+
+def names() -> tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+LIFE = register(StencilSpec(
+    name="life", radius=1, dtype="uint8", weights=BOX3,
+    update=_life_update, states=2, init=_life_init,
+    oracle_step=_life_oracle))
+
+HEAT = register(StencilSpec(
+    name="heat", radius=1, dtype="float32", weights=CROSS3,
+    update=_heat_update, init=_heat_init))
+
+GRAY_SCOTT = register(StencilSpec(
+    name="gray_scott", radius=1, dtype="float32", weights=CROSS3,
+    update=_gray_scott_update, channels=2, init=_gray_scott_init))
+
+WIREWORLD = register(StencilSpec(
+    name="wireworld", radius=1, dtype="uint8", weights=BOX3,
+    update=_wireworld_update, pre=_wireworld_pre, states=4,
+    init=_wireworld_init))
