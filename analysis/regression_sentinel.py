@@ -112,6 +112,15 @@ WATCH_FIELDS = (
     # line as context for the two rates that ARE watched.
     "sparse_cups",
     "sparse_vs_dense",
+    # Autotuner (PR 14): the tuned engine's rate and its ratio over the
+    # heuristic choice measured in the same process (RTT- and
+    # noise-cancelled, like vs_cellpacked; >= 1.0 by construction since
+    # the heuristic is in the race) — both higher-is-better by the
+    # cups/vs naming rules. A vs_heuristic sliding toward 1.0 means the
+    # tuner stopped finding wins; tuned_cups falling means the plan it
+    # persists got slower.
+    "tuned_cups",
+    "vs_heuristic",
 )
 
 
@@ -149,6 +158,14 @@ DEFAULT_MATCH = ("metric", "shape", "dtype", "steps", "batch", "resident",
                  "workload")
 
 _BACKEND_RANK = {"cpu": 0, "gpu": 1, "tpu": 2}
+
+#: ``plan_source`` vocabulary, rank-compared like backends: a line that
+#: ran under a tuned plan (freshly measured or loaded from the store —
+#: equally good, both are the tuner's measured choice) regressing to
+#: heuristic routing means the plan store silently stopped applying
+#: (quarantined plans, a bad MOMP_TUNE_PLANS path, MOMP_TUNE=0 leaking
+#: into CI) — exactly the downgrade shape BENCH_r04 hid for backends.
+_PLAN_RANK = {"store": 2, "fresh": 2, "heuristic": 1}
 
 
 def engine_rank(stamp) -> int:
@@ -245,6 +262,21 @@ def evaluate(entries: list[dict], *, n: int = 5, noise: float = 0.1,
         if (_BACKEND_RANK.get(new_backend, 0)
                 < _BACKEND_RANK.get(best, 0)):
             item = {"field": "platform", "new": new_backend,
+                    "baseline_best": best}
+            if cand_rec.get("fallback_reason"):
+                item["fallback_reason"] = cand_rec["fallback_reason"]
+            downgrades.append(item)
+
+    # Plan-provenance downgrade: tuned (store/fresh) -> heuristic means
+    # the autotuner's measured decision silently stopped being applied.
+    new_plan = cand_rec.get("plan_source")
+    base_plans = [(e.get("record") or {}).get("plan_source") for e in pool]
+    base_plans = [p for p in base_plans if p in _PLAN_RANK]
+    if new_plan in _PLAN_RANK and base_plans:
+        checked.append("plan_source")
+        best = max(base_plans, key=lambda p: _PLAN_RANK[p])
+        if _PLAN_RANK[new_plan] < _PLAN_RANK[best]:
+            item = {"field": "plan_source", "new": new_plan,
                     "baseline_best": best}
             if cand_rec.get("fallback_reason"):
                 item["fallback_reason"] = cand_rec["fallback_reason"]

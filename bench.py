@@ -697,6 +697,74 @@ def _sparse_ab_phase(n_steps: int, edge: int, tile: int) -> dict:
     return fields
 
 
+def _autotune_phase(args, workload: str) -> dict:
+    """The AUTOTUNE phase (``--autotune K``): install any persisted
+    plans from the store first (validated + parity-gated), then either
+    reuse the installed plan for this exact (workload, batch, board)
+    config — ``plan_source=store``, the persisted A/B numbers ride the
+    line and ``tune_retraces`` (the life_batch retrace DELTA across this
+    phase) proves the reuse dispatched without re-tracing — or run one
+    bounded measured tuning pass (``tune.runner.tune``) and persist the
+    winner: ``plan_source=fresh``. ``MOMP_TUNE=0`` skips the whole
+    phase with an explicit ``fallback_reason`` so the sentinel's match
+    keys still see every field. The heuristic-vs-tuned A/B is
+    ``heuristic_cups`` / ``tuned_cups`` / ``vs_heuristic`` — >= 1.0 by
+    construction because the heuristic's own choice is always among the
+    timed candidates."""
+    from mpi_and_open_mp_tpu.ops import pallas_life
+    from mpi_and_open_mp_tpu.serve import retrace_counts
+    from mpi_and_open_mp_tpu.tune import plans as tune_plans
+    from mpi_and_open_mp_tpu.tune import runner as tune_runner
+
+    shape = (args.tune_batch, args.tune_board, args.tune_board)
+    fields = {"tune_board": args.tune_board,
+              "tune_batch": args.tune_batch,
+              "tune_steps": args.autotune}
+    if not pallas_life._tune_enabled():
+        return {**fields, "plan_source": "heuristic",
+                "fallback_reason": "autotune skipped: MOMP_TUNE=0"}
+    before = retrace_counts()
+    plans_dir = args.plans or os.environ.get("MOMP_TUNE_PLANS") or None
+    store = tune_plans.PlanStore(plans_dir) if plans_dir else None
+    if store is not None:
+        fields["plans"] = store.install()
+        hit = store.lookup(workload, shape)
+        if hit is not None:
+            heur = hit.get("heuristic") or {}
+            fields.update({
+                "plan_source": "store",
+                "tuned_path": hit["choice"]["path"],
+                "tuned_cups": hit["tuned"]["cups"],
+                "heuristic_cups": heur.get("cups"),
+                "vs_heuristic": hit["vs_heuristic"],
+            })
+            after = retrace_counts()
+            fields["tune_retraces"] = {
+                k: after[k] - before.get(k, 0) for k in after
+                if after[k] - before.get(k, 0)}
+            return fields
+    res = tune_runner.tune(workload, shape, steps=args.autotune,
+                           store=store)
+    heur = res.get("heuristic") or {}
+    fields.update({
+        "plan_source": "fresh",
+        "tuned_path": res["tuned"]["path"],
+        "tuned_cups": res["tuned"]["cups"],
+        "heuristic_cups": heur.get("cups"),
+        "vs_heuristic": res["vs_heuristic"],
+        "tune_candidates": len(res["measurements"]),
+        "tune_rejected": len(res["rejected"]),
+    })
+    for k in ("plan_file", "aot_export", "digest"):
+        if k in res:
+            fields[f"tune_{k}" if k == "digest" else k] = res[k]
+    after = retrace_counts()
+    fields["tune_retraces"] = {
+        k: after[k] - before.get(k, 0) for k in after
+        if after[k] - before.get(k, 0)}
+    return fields
+
+
 def _stencil_bench(args, state, *, platform, device_kind, degraded,
                    backend_note) -> int:
     """The non-life headline (``--workload NAME``): the spec-generated
@@ -728,6 +796,20 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
                           "error": "parity check failed",
                           "phase": "parity"}))
         return 1
+
+    # Autotune phase (opt-in via --autotune K): non-life workloads tune
+    # through the same machinery (roll vs per-spec Pallas candidates).
+    # A failure costs its fields, never the line.
+    tuned = {}
+    if args.autotune:
+        state["phase"] = "autotune"
+        with obs_trace.span("bench.phase", phase="autotune",
+                            workload=spec.name):
+            try:
+                tuned = _autotune_phase(args, spec.name)
+            except Exception as e:
+                tuned = {"plan_source": "heuristic",
+                         "tune_error": f"{type(e).__name__}: {e}"[:200]}
 
     state["phase"] = "measure"
 
@@ -772,6 +854,10 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
         "device_kind": device_kind,
         "devices": jax.device_count(),
         "degraded": degraded,
+        # Plan provenance rides EVERY line like the engine stamps:
+        # heuristic unless the autotune phase overrides it below.
+        "plan_source": "heuristic",
+        **tuned,
         **metrics_fields,
         **backend_note,
     }
@@ -875,6 +961,33 @@ def main(argv=None) -> int:
                     help="append the stamped JSON line to this run ledger "
                     "(obs.ledger schema; default: $MOMP_LEDGER when set). "
                     "Judge it with analysis/regression_sentinel.py")
+    ap.add_argument("--autotune", type=int, default=0, metavar="K",
+                    help="also run the AUTOTUNE phase (any workload): "
+                    "install persisted plans from --plans (validated + "
+                    "oracle-parity-gated; plan_source=store reuses the "
+                    "recorded A/B with zero retraces), else one bounded "
+                    "measured tuning pass over the legal candidate space "
+                    "at (--tune-batch, --tune-board²) with K-step "
+                    "chained-differencing brackets, persisting the "
+                    "winner plus (life) its exported executable under "
+                    "one fingerprint digest (plan_source=fresh); "
+                    "reports tuned_cups / heuristic_cups / vs_heuristic "
+                    "on the JSON line; MOMP_TUNE=0 skips with an "
+                    "explicit fallback_reason")
+    ap.add_argument("--tune-board", type=int, default=64, metavar="N",
+                    help="board edge the autotune phase profiles "
+                    "(default %(default)s — small enough for CPU CI; "
+                    "the chip launchers pass the production shapes)")
+    ap.add_argument("--tune-batch", type=int, default=32, metavar="B",
+                    help="stack batch size the autotune phase profiles "
+                    "(default %(default)s)")
+    ap.add_argument("--plans", default=None, metavar="DIR",
+                    help="durable tuned-plan store directory (default "
+                    "$MOMP_TUNE_PLANS): momp-plan/1 records keyed by "
+                    "the serve/aotcache fingerprint digest, living "
+                    "beside the <digest>.aot executables; corrupt/"
+                    "stale/parity-failing records quarantine and the "
+                    "heuristics serve unchanged")
     args = ap.parse_args(argv)
     if args.ledger is None:
         args.ledger = os.environ.get("MOMP_LEDGER") or None
@@ -897,6 +1010,9 @@ def main(argv=None) -> int:
                 ap.error(f"{flag} is a life-workload phase; "
                          f"--workload {args.workload} runs the stencil "
                          "headline only")
+    if args.autotune and args.autotune < 16:
+        ap.error("--autotune needs >= 16 steps for the "
+                 "chained-differencing bracket")
     if args.sparse_ab:
         if args.sparse_ab < 16:
             ap.error("--sparse-ab needs >= 16 steps for the "
@@ -1151,6 +1267,19 @@ def _bench(args, state) -> int:
             except Exception as e:
                 batched = {"batch": args.batch,
                            "batched_error": f"{type(e).__name__}: {e}"[:200]}
+
+    # Autotune phase (opt-in via --autotune K): bounded measured tuning
+    # pass or persisted-plan reuse; heuristic-vs-tuned A/B fields ride
+    # the line. A failure costs its fields, never the bench line.
+    tuned = {}
+    if args.autotune:
+        state["phase"] = "autotune"
+        with obs_trace.span("bench.phase", phase="autotune"):
+            try:
+                tuned = _autotune_phase(args, "life")
+            except Exception as e:
+                tuned = {"plan_source": "heuristic",
+                         "tune_error": f"{type(e).__name__}: {e}"[:200]}
 
     # Serving-daemon phase (opt-in via --serve N): latency percentiles
     # and shed/degrade accounting from the supervised daemon. A failure
@@ -1489,9 +1618,14 @@ def _bench(args, state) -> int:
         # True whenever the watchdog degraded the run to CPU — the
         # machine-readable twin of backend_fallback.
         "degraded": res.degraded,
+        # Plan provenance rides EVERY line like the engine stamps
+        # (CPU-fallback lines included): heuristic unless the autotune
+        # phase overrides it via **tuned below.
+        "plan_source": "heuristic",
         **({"recovered": recovered} if recovered else {}),
         **ckpt_fields,
         **batched,
+        **tuned,
         **served,
         **sparse,
         **sharded,

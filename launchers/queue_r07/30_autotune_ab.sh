@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# r07 queued increment (ISSUE 14, DESIGN.md §16): the unified autotuner
+# on the real chip — bounded measured tuning passes at the acceptance
+# geometries (500^2 and 2048^2, B in {8, 32}), each landing a
+# heuristic-vs-tuned A/B (tuned_cups / vs_heuristic, >= 1.0 by
+# construction: the heuristic's choice is in the race) plus a durable
+# momp-plan/1 record whose digest co-locates the plan with the serve
+# layer's exported executable. The store persists across queue runs:
+# the FIRST pass per config tunes fresh (plan_source=fresh), later
+# passes reuse the installed plan with a zero-retrace tune phase
+# (plan_source=store) — the sentinel ranks {store, fresh} > heuristic,
+# so a plan store that silently stops applying on-chip flags as a
+# provenance downgrade. Every line lands in MOMP_LEDGER (exported by
+# tpu_queue_loop.sh) under the new plan-keyed baseline groups. One chip
+# process per bench run, sequential; exits nonzero on failure so the
+# loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+export MOMP_TUNE_PLANS="${MOMP_TUNE_PLANS:-results/plans_r07}"
+
+python bench.py --board 500 --steps 1000 \
+    --autotune 200 --tune-board 500 --tune-batch 8
+
+python bench.py --board 500 --steps 1000 \
+    --autotune 200 --tune-board 500 --tune-batch 32
+
+python bench.py --board 2048 --steps 500 \
+    --autotune 200 --tune-board 2048 --tune-batch 8
+
+python bench.py --board 2048 --steps 500 \
+    --autotune 200 --tune-board 2048 --tune-batch 32

@@ -53,9 +53,13 @@ ENV = "MOMP_LEDGER"
 #: ``workload`` joined in PR 13 (the stencil spec subsystem): a heat run
 #: and a life run at the same shape are different rules entirely —
 #: entries stamped before the field existed default to "life", which is
-#: exactly what they ran.
+#: exactly what they ran. ``plan`` joined in PR 14 (the autotuner): a
+#: line measured under a persisted/tuned plan ({store, fresh}) and a
+#: heuristic-routed line are different dispatch decisions — the sentinel
+#: treats tuned -> heuristic as a provenance downgrade.
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
-              "batch_pack_layout", "resident", "workload", "engine")
+              "batch_pack_layout", "resident", "workload", "plan",
+              "engine")
 
 _GIT_SHA: str | None = None
 
@@ -119,6 +123,9 @@ def stamp(record: dict, *, source: str = "bench.py",
         "resident": record.get("resident", "-"),
         # Pre-stencil lines carry no workload field: life, exactly.
         "workload": record.get("workload", "life"),
+        # "-" for lines that never consulted the autotuner; tuned lines
+        # carry the closed vocabulary {heuristic, fresh, store}.
+        "plan": record.get("plan_source", "-"),
         "engine": record.get("impl", "?"),
     }
     return {
@@ -170,7 +177,7 @@ def load(path: str) -> list[dict]:
 #: "unrecorded": entries stamped before the field joined KEY_FIELDS must
 #: keep matching new lines that carry the explicit "-" placeholder.
 _KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-",
-                 "workload": "life"}
+                 "workload": "life", "plan": "-"}
 
 
 def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:

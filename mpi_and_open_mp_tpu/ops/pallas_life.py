@@ -71,6 +71,99 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Installed tuned plans: (workload, *stack shape) -> engine path — the
+# shape rides whole so multi-channel stacks (gray_scott's 4-D (B, C, ny,
+# nx)) key cleanly. Populated by tune.plans.PlanStore.install() after
+# each record survives its CRC / fingerprint / parity gates; consulted
+# by native_path_batch BEFORE the static heuristics. MOMP_TUNE=0 is the
+# kill switch — read per call, not at import, so a triage export takes
+# effect on the very next dispatch.
+_PLANNED_PATHS: dict[tuple, str] = {}
+
+
+def _tune_enabled() -> bool:
+    return os.environ.get("MOMP_TUNE", "1") != "0"
+
+
+def _plan_key(workload: str, shape) -> tuple:
+    return (str(workload), *(int(x) for x in shape))
+
+
+def install_planned_path(workload: str, shape, path: str) -> None:
+    """Install a tuned engine path for one (workload, stack shape).
+    Only ``tune.plans`` calls this, AFTER the record passed its
+    durability and parity gates — nothing here re-validates."""
+    _PLANNED_PATHS[_plan_key(workload, shape)] = str(path)
+
+
+def planned_path(workload: str, shape) -> str | None:
+    """The installed tuned path for (workload, stack shape), or ``None``
+    when no plan is installed or ``MOMP_TUNE=0`` pins tuning off."""
+    if not _tune_enabled():
+        return None
+    return _PLANNED_PATHS.get(_plan_key(workload, shape))
+
+
+def clear_planned_paths() -> None:
+    _PLANNED_PATHS.clear()
+
+
+@contextlib.contextmanager
+def _planned_pinned(workload: str, shape, path: str | None):
+    """Pin one (workload, shape) plan entry for the duration — the
+    fingerprint trick behind plan/executable co-location: computing the
+    AOT fingerprint under the plan's choice pinned IN yields the same
+    digest the serving process computes once the plan is installed, so
+    ``<digest>.plan`` and ``<digest>.aot`` land side by side. Pinning
+    ``None`` removes any entry (how ``tune.space.heuristic_path`` asks
+    what the static ladder would do, untouched by the plan under test)."""
+    key = _plan_key(workload, shape)
+    missing = object()
+    prev = _PLANNED_PATHS.get(key, missing)
+    if path is None:
+        _PLANNED_PATHS.pop(key, None)
+    else:
+        _PLANNED_PATHS[key] = str(path)
+    try:
+        yield
+    finally:
+        if prev is missing:
+            _PLANNED_PATHS.pop(key, None)
+        else:
+            _PLANNED_PATHS[key] = prev
+
+
+def _planned_legal(
+    path: str, shape: tuple[int, int, int], on_tpu: bool,
+    allow_bitsliced: bool,
+) -> bool:
+    """Hard legality for an installed plan's path on THIS process: VMEM
+    fits, backend support, and the runtime pins (``MOMP_BITSLICE=0``,
+    the daemon's ``allow_bitsliced=False`` fallback rung) all stay
+    binding — a plan may override the BITSLICE_MIN_BATCH heuristic, but
+    never dispatch an engine that cannot run here."""
+    from mpi_and_open_mp_tpu.ops import bitlife
+
+    b, ny, nx = shape
+    if path == "bitsliced":
+        return (
+            allow_bitsliced
+            and _BITSLICE
+            and bitlife.fits_vmem_bitsliced(shape)
+        )
+    if path == "vmem":
+        return on_tpu and bitlife.fits_vmem_packed_batch(shape)
+    if path == "vmem-grid":
+        return on_tpu and bitlife.fits_vmem_packed((ny, nx))
+    if path == "fused":
+        return on_tpu and bitlife.fused_bits_supported((ny, nx))
+    if path == "frame":
+        return on_tpu and bitlife.plan_sharded_bits(
+            (ny, nx), 1, 1, False, False
+        ) is not None
+    return path == "xla"
+
+
 def fits_vmem(shape: tuple[int, int]) -> bool:
     ny, nx = shape
     return ny * nx * 4 <= _VMEM_BYTES_LIMIT
@@ -123,10 +216,20 @@ def native_path_batch(
     a batch exists for THROUGHPUT — interpret mode would grind B boards
     through a Python-level VM while the vmapped packed loop compiles on
     every backend (the batched kernels get their interpret-mode
-    coverage from tests/test_batched.py directly)."""
+    coverage from tests/test_batched.py directly).
+
+    An installed tuned plan (``tune/``, keyed by workload + stack
+    shape) is consulted FIRST and wins whenever its path is legal for
+    this process (:func:`_planned_legal`); the static ladder below is
+    the heuristic fallback and the no-plans behavior."""
     from mpi_and_open_mp_tpu.ops import bitlife
 
     b, ny, nx = shape
+    planned = planned_path("life", shape)
+    if planned is not None and _planned_legal(
+        planned, shape, on_tpu, allow_bitsliced
+    ):
+        return planned
     if (
         allow_bitsliced
         and _BITSLICE

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import argparse
 import collections
+import contextlib
 import json
 import os
 import sys
@@ -84,6 +85,7 @@ class ServingDaemon:
                  wal_fsync: str = "every-record",
                  wal_compact_bytes: int = 1 << 20,
                  aot_cache=None,
+                 plan_store=None,
                  worker_index: int | None = None,
                  pool_budget_bytes: int | None = None,
                  clock=time.monotonic, sleep=time.sleep):
@@ -104,6 +106,14 @@ class ServingDaemon:
         # bucket executables, so the first restored ticket never waits on
         # a trace+compile. None = every dispatch traces as before.
         self._aot = aot_cache
+        # Durable tuned-plan store (tune.plans.PlanStore) — installed at
+        # construction so EVERY resume rung (wal/checkpoint/fresh) comes
+        # up with plans steering native_path_batch before the first
+        # dispatch, exactly as the AOT preload warms executables. None =
+        # heuristics only, the historical behavior.
+        self._plans = plan_store
+        self._plans_summary = (plan_store.install()
+                               if plan_store is not None else None)
         self._created_at = self._clock()
         self._first_result_s: float | None = None  # cold-start latency
         # The journal's "one chunk" loss bound under every-chunk is
@@ -456,6 +466,7 @@ class ServingDaemon:
                 trace.event("serve.resume", source="wal",
                             tickets=len(rep.pending))
                 daemon._aot_preload(detail)
+                daemon._plans_note(detail)
                 return daemon, "wal", detail
         if checkpoint_path and os.path.exists(checkpoint_path):
             try:
@@ -475,10 +486,12 @@ class ServingDaemon:
                     detail["checkpoint_quarantine"] = q
             else:
                 daemon._aot_preload(detail)
+                daemon._plans_note(detail)
                 return daemon, "checkpoint", detail
         daemon = cls(policy, checkpoint_path=checkpoint_path,
                      wal_path=wal_path, wal_fsync=wal_fsync, **kw)
         trace.event("serve.resume", source="fresh", tickets=0)
+        daemon._plans_note(detail)
         return daemon, "fresh", detail
 
     def _aot_preload(self, detail: dict | None = None) -> dict | None:
@@ -500,6 +513,14 @@ class ServingDaemon:
         if detail is not None:
             detail["aot_preload"] = summary
         return summary
+
+    def _plans_note(self, detail: dict | None = None) -> dict | None:
+        """Record the plan-store install bookkeeping (done once, at
+        construction) in the resume detail — an exit-75 requeue restarts
+        with tuned plans AND their executables warm, observably."""
+        if self._plans_summary is not None and detail is not None:
+            detail["plans"] = self._plans_summary
+        return self._plans_summary
 
     # -- the supervised loop ----------------------------------------------
 
@@ -656,14 +677,32 @@ class ServingDaemon:
         if spec is not None and spec.name != "life":
             from mpi_and_open_mp_tpu import stencils
 
-            def stencil_native():
-                import jax.numpy as jnp
+            def stencil_roll(guarded: bool):
+                def run():
+                    import jax.numpy as jnp
 
-                if chaos.take_serve_fault():
-                    raise RuntimeError(
-                        "chaos: injected serve dispatch fault")
-                return np.asarray(stencils.run_roll_batch(
-                    spec, jnp.asarray(stack), steps))
+                    if guarded and chaos.take_serve_fault():
+                        raise RuntimeError(
+                            "chaos: injected serve dispatch fault")
+                    with (contextlib.nullcontext() if guarded
+                          else chaos.suppressed()):
+                        return np.asarray(stencils.run_roll_batch(
+                            spec, jnp.asarray(stack), steps))
+                return run
+
+            def stencil_pallas(guarded: bool):
+                def run():
+                    import jax.numpy as jnp
+
+                    if guarded and chaos.take_serve_fault():
+                        raise RuntimeError(
+                            "chaos: injected serve dispatch fault")
+                    with (contextlib.nullcontext() if guarded
+                          else chaos.suppressed()):
+                        return np.asarray(
+                            stencils.run_padded_pallas_batch(
+                                spec, jnp.asarray(stack), steps))
+                return run
 
             def stencil_oracle():
                 with chaos.suppressed():
@@ -672,8 +711,28 @@ class ServingDaemon:
                         out[b] = stencils.oracle_run(spec, out[b], steps)
                     return out
 
-            return [(f"batch:stencil:{spec.name}", stencil_native),
-                    ("oracle", stencil_oracle)]
+            # The per-spec Pallas padded kernel is a REAL rung for
+            # single-channel specs: primary when an installed tuned plan
+            # picked it, else the guarded fallback under the roll engine
+            # (so the tuner's candidate is exactly what serving runs).
+            pallas_ok = stencils.pallas_batch_supported(spec, stack.shape)
+            planned = pallas_life.planned_path(spec.name, stack.shape)
+            if pallas_ok and planned == "stencil:pallas":
+                rungs = [
+                    (f"batch:stencil-pallas:{spec.name}",
+                     stencil_pallas(True)),
+                    (f"batch:stencil:{spec.name}", stencil_roll(False)),
+                ]
+            elif pallas_ok:
+                rungs = [
+                    (f"batch:stencil:{spec.name}", stencil_roll(True)),
+                    (f"batch:stencil-pallas:{spec.name}",
+                     stencil_pallas(False)),
+                ]
+            else:
+                rungs = [(f"batch:stencil:{spec.name}",
+                          stencil_roll(True))]
+            return rungs + [("oracle", stencil_oracle)]
 
         on_tpu = jax.default_backend() == "tpu"
         path = pallas_life.native_path_batch(stack.shape, on_tpu=on_tpu)
@@ -937,6 +996,9 @@ class ServingDaemon:
             out["aot_stale"] = s["stale"]
             out["aot_deserialize_s"] = s["deserialize_s"]
             out["aot_build_s"] = s["build_s"]
+        if self._plans_summary is not None:
+            out["plans"] = self._plans_summary
+            out["plans_installed"] = self._plans_summary["installed"]
         return out
 
 
@@ -1007,6 +1069,16 @@ def build_parser() -> argparse.ArgumentParser:
                    "shows zero jit.retrace{fn=life_batch_*} ticks; a "
                    "corrupt/stale artifact quarantines and falls back "
                    "to a fresh trace (aot:corrupt provenance)")
+    p.add_argument("--plans", default=None, metavar="DIR",
+                   help="durable tuned-plan store directory (default "
+                   "$MOMP_TUNE_PLANS; usually the SAME directory as "
+                   "--aot-cache — plan and executable share one "
+                   "fingerprint digest): momp-plan/1 records are "
+                   "validated, parity-gated, and installed before the "
+                   "first dispatch so native_path_batch follows the "
+                   "measured winner; corrupt/stale/parity-failing "
+                   "records quarantine and the heuristics serve "
+                   "unchanged; MOMP_TUNE=0 ignores the store entirely")
     p.add_argument("--resume", action="store_true",
                    help="restore drained tickets before serving the "
                    "(possibly empty) new burst — WAL replay first, then "
@@ -1078,6 +1150,13 @@ def main(argv=None) -> int:
 
         aot = AOTCache(aot_dir)
         rec_aot_cache = os.path.abspath(aot_dir)
+    plans_dir = args.plans or os.environ.get("MOMP_TUNE_PLANS") or None
+    plan_store = None
+    if plans_dir:
+        from mpi_and_open_mp_tpu.tune.plans import PlanStore
+
+        plan_store = PlanStore(plans_dir)
+        rec_plans_dir = os.path.abspath(plans_dir)
     try:
         backoff_base, backoff_cap, backoff_jitter = _parse_backoff(
             args.backoff)
@@ -1094,11 +1173,14 @@ def main(argv=None) -> int:
                  "workload": args.workload}
     if aot is not None:
         rec["aot_cache"] = rec_aot_cache
+    if plan_store is not None:
+        rec["plan_store"] = rec_plans_dir
     try:
         if args.resume:
             daemon, source, detail = ServingDaemon.resume_any(
                 wal_path=args.wal, checkpoint_path=args.checkpoint,
-                policy=policy, wal_fsync=args.wal_fsync, aot_cache=aot)
+                policy=policy, wal_fsync=args.wal_fsync, aot_cache=aot,
+                plan_store=plan_store)
             rec["resume_source"] = source
             rec.update(detail)
             rec["resumed_tickets"] = daemon.queue.depth()
@@ -1106,7 +1188,7 @@ def main(argv=None) -> int:
             daemon = ServingDaemon(
                 policy, checkpoint_path=args.checkpoint,
                 wal_path=args.wal, wal_fsync=args.wal_fsync,
-                aot_cache=aot)
+                aot_cache=aot, plan_store=plan_store)
         if aot is not None and args.requests > 0 and args.workload == "life":
             # Preload for the incoming burst too (the resume preload
             # covered only already-pending shapes): every bucket program

@@ -9,7 +9,9 @@ from .engine import (  # noqa: F401
     aggregate_roll,
     offsets,
     oracle_run,
+    pallas_batch_supported,
     parity_ok,
+    run_padded_pallas_batch,
     run_roll,
     run_roll_batch,
     step_numpy,

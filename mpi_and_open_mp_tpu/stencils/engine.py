@@ -189,3 +189,44 @@ def run_roll_batch(spec: StencilSpec, stack, n: int):
     matching the life batch engines' calling convention, so a bucket
     compiles once per stack shape)."""
     return _run_roll_batch_jit(spec)(stack, n)
+
+
+def pallas_batch_supported(spec: StencilSpec, shape) -> bool:
+    """Whether the per-spec Pallas padded kernel can serve a batched
+    ``(B, ny, nx)`` stack of this spec: single-channel rules only. The
+    kernel rides the stack through the padded block's leading axis, and
+    a multi-channel update (which indexes ``center[0]``/``center[1]``)
+    would misread that axis as channels — gray_scott stays on the
+    vmapped roll engine."""
+    return int(spec.channels) == 1 and len(tuple(shape)) == 3
+
+
+@functools.lru_cache(maxsize=None)
+def _run_padded_pallas_batch_jit(spec: StencilSpec):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_and_open_mp_tpu.ops import pallas_life
+
+    r = spec.radius
+
+    def step(stack):
+        padded = jnp.pad(stack, ((0, 0), (r, r), (r, r)), mode="wrap")
+        return pallas_life.stencil_step_padded_pallas(spec, padded)
+
+    def run(stack, n):
+        return lax.fori_loop(0, n, lambda _, s: step(s), stack)
+
+    return jax.jit(run)
+
+
+def run_padded_pallas_batch(spec: StencilSpec, stack, n: int):
+    """``n`` chained steps of a single-channel stack through the
+    spec-generic Pallas padded kernel (``ops.pallas_life.
+    stencil_step_padded_pallas``): wrap-pad the halo, one kernel launch
+    per step, same runtime-scalar ``n`` contract as
+    :func:`run_roll_batch`. Over-VMEM blocks degrade to the compiled
+    jnp interior step inside the same loop — the caller never has to
+    re-plan. Gate callers on :func:`pallas_batch_supported`."""
+    return _run_padded_pallas_batch_jit(spec)(stack, n)

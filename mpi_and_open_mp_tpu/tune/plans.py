@@ -1,0 +1,298 @@
+"""Durable tuned-plan store: one fingerprint, plan AND executable.
+
+A tuned plan is only worth persisting if the process that reloads it can
+PROVE it still applies. Three gates run before a record may steer a
+dispatch, mirrored exactly from ``serve/aotcache.py``:
+
+1. **Envelope** — ``momp-plan/1`` records are CRC-framed like AOT
+   artifacts (magic + length + CRC32 + pickle). A flipped bit anywhere
+   is ``corrupt``; the file is quarantined via
+   ``utils.checkpoint.quarantine`` and the heuristics serve unchanged.
+2. **Fingerprint** — the record's key is the SAME dict
+   ``serve.aotcache.fingerprint`` computes, evaluated with the plan's
+   choice pinned in (:func:`fingerprint_for`). Any drift — jax/jaxlib
+   version, kernel source hash, platform, silicon, topology — recomputes
+   to a different key and the record is ``stale``. Because the digest is
+   shared, ``<digest>.plan`` sits next to the ``<digest>.aot`` the serve
+   layer builds once the plan is installed: one identity for the
+   decision and its compiled form.
+3. **Parity** — before installation the plan's engine must reproduce the
+   NumPy oracle on a seeded stack. For life plans with a co-located
+   ``.aot`` the gate runs the stored ``jax.export`` executable itself
+   (``Exported.call`` — zero retraces, the same binary that will serve);
+   otherwise the live engine. A wrong answer quarantines the plan with
+   label ``parity`` — it is never installed, whatever it claims to win.
+
+``MOMP_TUNE=0`` short-circuits :meth:`PlanStore.install` entirely — the
+kill switch restores pure-heuristic behavior without touching the store.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import struct
+import zlib
+
+import numpy as np
+
+from mpi_and_open_mp_tpu.serve import aotcache
+from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
+
+PLAN_MAGIC = b"MOMP-PLAN/1\n"
+PLAN_SCHEMA = "momp-plan/1"
+_HEADER = struct.Struct(">QI")  # payload length, CRC32
+
+#: Oracle steps for the install-time parity gate — enough for a wrong
+#: engine/rule/layout to diverge, cheap enough to run on every install.
+PARITY_STEPS = 8
+_PARITY_SEED = 46
+
+
+class PlanError(ValueError):
+    """A plan record that must not steer a dispatch. ``kind`` is the
+    provenance bucket: ``"corrupt"`` (bad magic/length/CRC/undecodable
+    payload/malformed record) or ``"stale"`` (intact envelope written
+    under a different schema or environment)."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+
+
+def fingerprint_for(workload: str, shape, dtype, path: str) -> dict:
+    """The aotcache fingerprint WITH the plan's choice pinned in — the
+    trick that co-locates plan and executable: the serving process
+    computes this exact dict once the plan is installed (its
+    ``engine_path`` field reflects the planned path), so both sides
+    agree on one digest. Non-life fingerprints pin the life entry OUT
+    instead, so they never depend on which life plan happens to be
+    installed when they are computed."""
+    from mpi_and_open_mp_tpu.ops import pallas_life
+
+    shape = tuple(int(x) for x in shape)
+    pin = str(path) if workload == "life" else None
+    with pallas_life._planned_pinned("life", shape, pin):
+        return aotcache.fingerprint(shape, dtype, workload=str(workload))
+
+
+def save_plan(path: str, record: dict) -> None:
+    """Write one plan record crash-atomically (the same CRC frame +
+    tmp/fsync/replace/dir-fsync dance as ``aotcache.save_artifact``)."""
+    payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+    framed = (PLAN_MAGIC
+              + _HEADER.pack(len(payload), zlib.crc32(payload))
+              + payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fd:
+        fd.write(framed)
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    checkpoint_mod._fsync_dir(path)
+
+
+def load_plan(path: str) -> dict:
+    """Read one record back, fully validated BEFORE it can steer
+    anything: magic, header, length, CRC, payload decode (failures are
+    ``corrupt``), then the schema stamp (``stale``). Returns the record
+    dict; raises :class:`PlanError`."""
+    try:
+        with open(path, "rb") as fd:
+            framed = fd.read()
+    except OSError as e:
+        raise PlanError(
+            "corrupt", f"unreadable plan record at {path} "
+            f"({type(e).__name__}: {e})") from e
+    head = len(PLAN_MAGIC) + _HEADER.size
+    if not framed.startswith(PLAN_MAGIC):
+        raise PlanError(
+            "corrupt", f"plan record at {path} has a bad magic header — "
+            "not a MOMP-PLAN/1 file (or corrupted at offset 0)")
+    if len(framed) < head:
+        raise PlanError(
+            "corrupt", f"plan record at {path} is truncated inside its "
+            f"header ({len(framed)} of {head} header bytes)")
+    length, want_crc = _HEADER.unpack(framed[len(PLAN_MAGIC):head])
+    payload = framed[head:]
+    if len(payload) != length:
+        raise PlanError(
+            "corrupt", f"plan record at {path} is truncated: payload is "
+            f"{len(payload)} bytes, header promises {length}")
+    if zlib.crc32(payload) != want_crc:
+        raise PlanError(
+            "corrupt", f"plan record at {path} failed its CRC "
+            f"(stored {want_crc:#010x}, recomputed "
+            f"{zlib.crc32(payload):#010x}) — the file is corrupt")
+    try:
+        record = pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001 — any decode failure
+        raise PlanError(
+            "corrupt", f"plan record at {path} passed its CRC but failed "
+            f"to decode ({type(e).__name__}: {e})"[:400]) from e
+    if not isinstance(record, dict) or record.get("schema") != PLAN_SCHEMA:
+        raise PlanError(
+            "stale", f"plan record at {path} carries schema "
+            f"{record.get('schema') if isinstance(record, dict) else '?'!r},"
+            f" want {PLAN_SCHEMA!r}")
+    if not isinstance(record.get("key"), dict) \
+            or not isinstance(record.get("choice"), dict):
+        raise PlanError(
+            "corrupt", f"plan record at {path} decodes but is missing its "
+            "key/choice fields")
+    return record
+
+
+class PlanStore:
+    """One directory of ``<digest>.plan`` records (plus the serve
+    layer's ``<digest>.aot`` executables living beside them).
+
+    ``install()`` is the one entry point: scan, validate, parity-gate,
+    then hand every surviving choice to
+    ``pallas_life.install_planned_path`` so ``native_path_batch``
+    consults it before the heuristics. Every rejection is quarantined
+    on disk, counted, and traced — plan rot is observable, never
+    silent, and the behavioral fallback is always "the heuristics,
+    unchanged"."""
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = os.path.abspath(os.fspath(root))
+        os.makedirs(self.root, exist_ok=True)
+        self._installed: dict[tuple, dict] = {}
+
+    def plan_path(self, digest: str) -> str:
+        return os.path.join(self.root, digest + ".plan")
+
+    def save(self, record: dict) -> str:
+        """Persist one tuned record under its fingerprint digest;
+        returns the file path."""
+        path = self.plan_path(aotcache.digest_for(record["key"]))
+        save_plan(path, record)
+        return path
+
+    def lookup(self, workload: str, shape) -> dict | None:
+        """The INSTALLED record for (workload, stack shape), or None."""
+        from mpi_and_open_mp_tpu.ops import pallas_life
+
+        return self._installed.get(pallas_life._plan_key(workload, shape))
+
+    def _note(self, status: str, **fields) -> None:
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        metrics.inc("tune.plan", status=status)
+        trace.event("tune.plan", status=status, **fields)
+
+    def install(self, parity_gate: bool = True) -> dict:
+        """Scan the store, validate and parity-gate every record, and
+        install the survivors. Returns the bookkeeping summary the
+        daemon/bench lines stamp."""
+        from mpi_and_open_mp_tpu.ops import pallas_life
+
+        summary = {"scanned": 0, "installed": 0, "corrupt": 0,
+                   "stale": 0, "parity_rejected": 0, "disabled": False,
+                   "plans": []}
+        if not pallas_life._tune_enabled():
+            summary["disabled"] = True
+            return summary
+        for path in sorted(glob.glob(os.path.join(self.root, "*.plan"))):
+            summary["scanned"] += 1
+            try:
+                record = load_plan(path)
+                choice = record["choice"]
+                workload = str(choice["workload"])
+                shape = tuple(int(x) for x in choice["shape"])
+                dtype, engine = choice["dtype"], str(choice["path"])
+            except PlanError as e:
+                summary[e.kind] += 1
+                q = checkpoint_mod.quarantine(path, label=e.kind)
+                self._note(e.kind, path=path, quarantined=q or "",
+                           error=str(e)[:200])
+                continue
+            except Exception as e:  # noqa: BLE001 — malformed choice
+                summary["corrupt"] += 1
+                q = checkpoint_mod.quarantine(path, label="corrupt")
+                self._note("corrupt", path=path, quarantined=q or "",
+                           error=f"{type(e).__name__}: {e}"[:200])
+                continue
+            want = fingerprint_for(workload, shape, dtype, engine)
+            if record["key"] != want:
+                drift = sorted(k for k in set(record["key"]) | set(want)
+                               if record["key"].get(k) != want.get(k))
+                summary["stale"] += 1
+                q = checkpoint_mod.quarantine(path, label="stale")
+                self._note("stale", path=path, quarantined=q or "",
+                           error=f"fingerprint drift: {drift}"[:200])
+                continue
+            if parity_gate and not self._parity_ok(record, path):
+                summary["parity_rejected"] += 1
+                continue
+            pallas_life.install_planned_path(workload, shape, engine)
+            self._installed[pallas_life._plan_key(workload, shape)] = record
+            summary["installed"] += 1
+            summary["plans"].append({
+                "workload": workload, "shape": list(shape),
+                "path": engine,
+                "vs_heuristic": record.get("vs_heuristic")})
+            self._note("installed", path=path, workload=workload,
+                       engine=engine)
+        return summary
+
+    def _parity_ok(self, record: dict, plan_file: str) -> bool:
+        """Prove the plan's engine against the NumPy oracle before it
+        may steer anything. Life plans with a co-located ``.aot`` gate
+        the stored executable itself — the exact binary a warm serve
+        process dispatches, so a wrong/foreign artifact rejects the
+        plan; an UNREADABLE artifact merely quarantines itself (the
+        serve layer rebuilds it) and the gate falls back to the live
+        engine. A parity failure quarantines the plan as ``parity``."""
+        import jax.numpy as jnp
+
+        from mpi_and_open_mp_tpu import stencils
+        from mpi_and_open_mp_tpu.ops import pallas_life
+        from mpi_and_open_mp_tpu.tune import space
+
+        choice = record["choice"]
+        workload = str(choice["workload"])
+        shape = tuple(int(x) for x in choice["shape"])
+        b, ny, nx = shape
+        try:
+            spec = stencils.get(workload)
+            rng = np.random.default_rng(_PARITY_SEED)
+            stack = np.stack(
+                [spec.init(rng, (ny, nx)) for _ in range(b)]
+            ).astype(np.dtype(choice["dtype"]))
+            aot = os.path.join(
+                self.root, aotcache.digest_for(record["key"]) + ".aot")
+            exp = None
+            if workload == "life" and os.path.exists(aot):
+                try:
+                    exp = aotcache.load_artifact(aot, record["key"])
+                except aotcache.ArtifactError as e:
+                    checkpoint_mod.quarantine(aot, label=e.kind)
+                    self._note("aot_" + e.kind, path=aot,
+                               error=str(e)[:200])
+            if exp is not None:
+                got = np.asarray(exp.call(jnp.asarray(stack),
+                                          jnp.int32(PARITY_STEPS)))
+            else:
+                with pallas_life._planned_pinned(
+                        workload, shape, str(choice["path"])):
+                    run = space.runner_for(workload, str(choice["path"]))
+                    got = np.asarray(run(jnp.asarray(stack),
+                                         jnp.int32(PARITY_STEPS)))
+            ok = got.shape == stack.shape and all(
+                stencils.parity_ok(
+                    spec, got[i],
+                    stencils.oracle_run(spec, stack[i], PARITY_STEPS))
+                for i in range(b))
+        except Exception as e:  # noqa: BLE001 — a broken engine is a
+            # rejection, never a crash: the heuristics keep serving.
+            ok = False
+            self._note("parity_error", path=plan_file,
+                       error=f"{type(e).__name__}: {e}"[:200])
+        if not ok:
+            q = checkpoint_mod.quarantine(plan_file, label="parity")
+            self._note("parity_rejected", path=plan_file,
+                       quarantined=q or "")
+        return ok

@@ -1,0 +1,187 @@
+"""Candidate space enumeration: every LEGAL plan for one configuration.
+
+Every performance-critical decision in the stack is a static heuristic
+today — the bitsliced gate (``BITSLICE_MIN_BATCH``, VMEM floors), the
+cell-packed batch ladder, the roll-vs-Pallas stencil dispatch, the
+pow2-vs-plane-32 bucket rounding, the row decomposition. PAPERS.md
+"Efficient Process-to-Node Mapping Algorithms for Stencil Computations"
+shows the right choice is workload- and topology-dependent; this module
+enumerates the choices so ``tune.runner`` can MEASURE them instead.
+
+A :class:`Candidate` names one complete plan: the engine path, the pack
+layout it implies, the batch-bucket rounding the serve layer should use
+for it, and the decomposition axis order. Enumeration is *legality*
+filtered — a candidate is listed only if this process could actually
+dispatch it (VMEM fits, backend support, channel-count support), so the
+runner never wastes profile budget on a path that cannot run, and the
+heuristic's own choice is always in the list (which is what makes the
+measured ``vs_heuristic`` ratio >= 1.0 by construction).
+
+Axis order is enumerated from the topology (single-device profiling
+covers ``"row"`` only — the repo's decomposition; multi-device meshes
+add ``"col"`` as a future profile axis), and the runner profiles only
+what a single process can honestly time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: Bucket-rounding vocabulary: the serve batcher pads bitsliced-eligible
+#: buckets to 32-board plane multiples and everything else to the pow2
+#: ladder (``serve.batcher.bucket_batch_size``).
+BUCKET_PLANE32 = "plane32"
+BUCKET_POW2 = "pow2"
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One complete tunable plan for a (workload, stack shape) pair."""
+
+    workload: str
+    #: Engine path: the ``native_path_batch`` vocabulary for life
+    #: (``bitsliced``/``vmem``/``vmem-grid``/``fused``/``frame``/
+    #: ``xla``) or ``stencil:roll``/``stencil:pallas`` for other specs.
+    path: str
+    #: ``bitsliced`` / ``cell-packed`` for life, ``-`` for stencil paths.
+    pack_layout: str
+    #: Batch-bucket rounding the path wants (plane32 iff bitsliced).
+    bucket_rounding: str
+    #: Decomposition axis order (single-process profiling: "row").
+    axis_order: str = "row"
+
+
+def axis_orders(device_count: int = 1) -> tuple[str, ...]:
+    """Legal decomposition axis orders for a topology. One device has
+    exactly one (nothing to decompose); multi-device meshes list the
+    column order too so a future multi-chip profile pass can time it."""
+    return ("row",) if int(device_count) <= 1 else ("row", "col")
+
+
+def life_paths(shape: tuple[int, int, int], on_tpu: bool) -> list[str]:
+    """Every batched life engine path this process can LEGALLY dispatch
+    for ``shape`` — the heuristic's pick is always among them. Unlike
+    the heuristic, the bitsliced candidate ignores ``BITSLICE_MIN_BATCH``
+    (the gate boundary is exactly what the tuner exists to re-measure);
+    hard gates (VMEM fits, the ``MOMP_BITSLICE`` kill switch, backend
+    support) stay binding."""
+    from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+    b, ny, nx = (int(x) for x in shape)
+    paths = []
+    if pallas_life._BITSLICE and bitlife.fits_vmem_bitsliced((b, ny, nx)):
+        paths.append("bitsliced")
+    if on_tpu:
+        if bitlife.fits_vmem_packed_batch((b, ny, nx)):
+            paths.append("vmem")
+        if bitlife.fits_vmem_packed((ny, nx)):
+            paths.append("vmem-grid")
+        if bitlife.fused_bits_supported((ny, nx)):
+            paths.append("fused")
+        if bitlife.plan_sharded_bits((ny, nx), 1, 1, False, False) is not None:
+            paths.append("frame")
+    paths.append("xla")
+    return paths
+
+
+def stencil_paths(spec, shape: tuple[int, int, int]) -> list[str]:
+    """Legal batched engine paths for a non-life stencil spec: the
+    vmapped roll engine always, plus the per-spec Pallas padded kernel
+    when the spec supports a batch axis (single-channel only — see
+    ``stencils.engine.pallas_batch_supported``)."""
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+
+    paths = ["stencil:roll"]
+    if stencil_engine.pallas_batch_supported(spec, shape):
+        paths.append("stencil:pallas")
+    return paths
+
+
+def pack_layout_for(path: str) -> str:
+    if path == "bitsliced":
+        return "bitsliced"
+    if path.startswith("stencil:"):
+        return "-"
+    return "cell-packed"
+
+
+def bucket_rounding_for(path: str) -> str:
+    return BUCKET_PLANE32 if path == "bitsliced" else BUCKET_POW2
+
+
+def heuristic_path(workload: str, shape: tuple[int, int, int],
+                   on_tpu: bool) -> str:
+    """The path the STATIC heuristics would pick today — the baseline
+    every tuned plan is measured against. Computed with any installed
+    plan pinned OUT, so tuning never grades itself against itself."""
+    from mpi_and_open_mp_tpu.ops import pallas_life
+
+    if workload == "life":
+        with pallas_life._planned_pinned(workload, shape, None):
+            return pallas_life.native_path_batch(tuple(shape), on_tpu=on_tpu)
+    return "stencil:roll"
+
+
+def candidates(workload: str, shape: tuple[int, int, int], *,
+               on_tpu: bool | None = None,
+               device_count: int = 1) -> list[Candidate]:
+    """Every legal candidate for (workload, stack shape, topology),
+    heuristic-first (ties in the runner's argmin then keep the
+    heuristic, so plans only move when a candidate measurably wins)."""
+    import jax
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() == "tpu"
+    if workload == "life":
+        paths = life_paths(shape, on_tpu)
+    else:
+        from mpi_and_open_mp_tpu import stencils
+
+        paths = stencil_paths(stencils.get(workload), shape)
+    heur = heuristic_path(workload, shape, on_tpu)
+    if heur in paths:
+        paths = [heur] + [p for p in paths if p != heur]
+    out = []
+    for axis in axis_orders(device_count):
+        for p in paths:
+            out.append(Candidate(
+                workload=str(workload), path=p,
+                pack_layout=pack_layout_for(p),
+                bucket_rounding=bucket_rounding_for(p),
+                axis_order=axis))
+    return out
+
+
+def runner_for(workload: str, path: str):
+    """The callable ``(stack_jnp, n) -> stack_jnp`` that dispatches one
+    candidate path directly (bypassing the heuristic dispatcher, which
+    would re-plan). Raises ``ValueError`` on an unknown path so a stale
+    plan record can never silently run the wrong engine."""
+    from mpi_and_open_mp_tpu.ops import bitlife, pallas_life
+
+    if workload == "life":
+        interp = pallas_life._interpret()
+        if path == "bitsliced":
+            return lambda s, n: bitlife.life_run_bitsliced_batch(
+                s, n, interpret=interp)
+        if path in ("vmem", "vmem-grid"):
+            return lambda s, n: bitlife.life_run_vmem_bits_batch(
+                s, n, interpret=interp, resident=(path == "vmem"))
+        if path == "fused":
+            return bitlife.life_run_fused_bits_batch
+        if path == "frame":
+            return bitlife.life_run_frame_bits_batch
+        if path == "xla":
+            return bitlife.life_run_bits_xla_batch
+        raise ValueError(f"unknown life engine path {path!r}")
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+
+    spec = stencils.get(workload)
+    if path == "stencil:roll":
+        return lambda s, n: stencils.run_roll_batch(spec, s, n)
+    if path == "stencil:pallas":
+        return lambda s, n: stencil_engine.run_padded_pallas_batch(
+            spec, s, n)
+    raise ValueError(f"unknown stencil engine path {path!r} "
+                     f"for workload {workload!r}")
