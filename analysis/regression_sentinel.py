@@ -121,6 +121,14 @@ WATCH_FIELDS = (
     # persists got slower.
     "tuned_cups",
     "vs_heuristic",
+    # Persistent halo plans (PR 15): the overlapped sharded rate and its
+    # ratio over the sequential schedule measured in the same process
+    # (RTT- and noise-cancelled, like vs_heuristic) — both
+    # higher-is-better by the cups/vs naming rules. A vs_sequential
+    # sliding toward 1.0 means the ghost exchange stopped hiding behind
+    # the interior stencil.
+    "sharded_overlap_cups",
+    "vs_sequential",
 )
 
 
@@ -149,7 +157,8 @@ def direction_for(field: str) -> str:
 #: Record fields carrying engine provenance, rank-compared for downgrades.
 PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
                      "attention_engine", "attention_hop_engine",
-                     "attention_hop_engine_bwd", "sparse_engine")
+                     "attention_hop_engine_bwd", "sparse_engine",
+                     "sharded_halo")
 
 #: ``workload`` joined in PR 13: a heat line and a life line of the same
 #: shape are different rules — they must never share a baseline group
@@ -177,13 +186,20 @@ def engine_rank(stamp) -> int:
     ``batch:``/``local:`` prefixes don't change the tier. The sparse
     active-tile stamp (``sparse:t<tile>``) sits above everything dense:
     on the mostly-dead workload it serves, a silent flip to
-    ``dense:crossover`` is THE downgrade this field exists to catch."""
+    ``dense:crossover`` is THE downgrade this field exists to catch.
+    The halo schedule stamp (``overlap:*`` vs ``seq:*``) ranks overlap
+    above every sequential tier: a ``sharded_halo`` flipping from
+    ``overlap:deferred`` to ``seq:halo`` (the MOMP_HALO_OVERLAP=0 kill
+    switch left on, or a geometry gate silently engaging) is a
+    provenance downgrade even when the rates are within noise."""
     s = str(stamp or "")
     for prefix in ("batch:", "local:"):
         if s.startswith(prefix):
             s = s[len(prefix):]
     if s.startswith("sparse"):
         return 5
+    if s.startswith("overlap:"):
+        return 4
     if s.startswith("bitsliced"):
         return 4
     if "pallas" in s:

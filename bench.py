@@ -697,6 +697,166 @@ def _sparse_ab_phase(n_steps: int, edge: int, tile: int) -> dict:
     return fields
 
 
+def _sharded_ab_phase(args, workload: str) -> dict:
+    """The SHARDED HALO A/B (``--sharded-ab K``): K torus steps of a
+    ``--sharded-board``² board through the plan-scheduled sharded engine
+    (``stencils.engine``), overlap schedule versus forced-sequential
+    baseline over the SAME mesh. Honesty discipline matches the sparse
+    A/B: the overlap leg is oracle-parity-gated first (8 steps), the seq
+    leg must match it bit-exactly, both rates are chain-differenced (K
+    and 2K from warm executables, min-of-2), and the two full-run final
+    boards must be BIT-identical — the overlap split computes every cell
+    with the same arithmetic, only the iteration space is partitioned.
+    The exposed-vs-hidden accounting rides a separate exchange-only
+    microbench: ``transfer_s`` prices the ghost ppermutes alone per
+    round, ``exposed_s`` is the remainder the overlap failed to hide
+    behind interior compute, and their ratio is the overlap efficiency
+    (``halo.ab`` trace event + the same fields on the line). The
+    ``sharded_halo`` stamp is what the overlap leg actually resolved to
+    (``overlap:*``, or ``seq:*`` when the ``MOMP_HALO_OVERLAP=0`` kill
+    switch or a degenerate geometry downgraded it — the ledger keys on
+    it and the sentinel treats that downgrade as a failure)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding
+
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.obs import trace as obs_trace
+    from mpi_and_open_mp_tpu.parallel import haloplan, mesh as mesh_lib
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    n_steps, edge = args.sharded_ab, args.sharded_board
+    spec = stencils.get(workload)
+    fields = {"sharded_ab_board": edge, "sharded_ab_steps": n_steps}
+    if jax.device_count() < 2:
+        fields["sharded_ab_error"] = (
+            "needs >= 2 devices (the halo exchange engages from 2 "
+            "shards); CI runs it under the 8-virtual-device CPU mesh")
+        return fields
+    mesh = mesh_lib.make_mesh_1d()  # every device on y: row layout
+    py = mesh.shape.get("y", 1)
+    if edge % py:
+        fields["sharded_ab_error"] = (
+            f"--sharded-board {edge} does not divide the {py}-way mesh")
+        return fields
+
+    rng = np.random.default_rng(46)
+    board = spec.init(rng, (edge, edge))
+
+    # Oracle gate on the overlap leg (8 steps, emits the halo.overlap
+    # span), then the seq leg (halo.seq span) must match it bit-exactly
+    # — transitively oracle-exact. Both schedule stamps ride the line.
+    got8 = np.asarray(stencil_engine.run_sharded(
+        spec, board, 8, mesh=mesh, layout="row"))
+    plan_ovl = stencil_engine.run_sharded.last_plan
+    fields["sharded_halo"] = plan_ovl.engine
+    if not stencils.parity_ok(spec, got8,
+                              stencils.oracle_run(spec, board, 8)):
+        fields["sharded_ab_error"] = (
+            "overlap schedule failed oracle parity")
+        return fields
+    seq8 = np.asarray(stencil_engine.run_sharded(
+        spec, board, 8, mesh=mesh, layout="row", overlap=False))
+    fields["sharded_seq_halo"] = stencil_engine.run_sharded.last_plan.engine
+    if not np.array_equal(got8, seq8):
+        fields["sharded_ab_error"] = (
+            "overlap and sequential schedules diverged at 8 steps")
+        return fields
+
+    run_ovl, _ = stencil_engine.make_sharded_runner(
+        spec, mesh, "row", (edge, edge))
+    run_seq, _ = stencil_engine.make_sharded_runner(
+        spec, mesh, "row", (edge, edge), overlap=False)
+    pspec = stencil_engine._sharded_pspec("row", spec.channels)
+    dev_board = jax.device_put(jnp.asarray(board, spec.dtype),
+                               NamedSharding(mesh, pspec))
+
+    def timed(run, n):
+        t0 = time.perf_counter()
+        anchor_sync(run(dev_board, n), fetch_all=True)
+        return time.perf_counter() - t0
+
+    def per_step(run):
+        # run() jit-caches per STATIC n: warm both lengths outside the
+        # brackets (the 2K warm-up doubles as the full-run final), then
+        # chain-difference so the per-dispatch overhead cancels.
+        anchor_sync(run(dev_board, n_steps), fetch_all=True)
+        final = run(dev_board, 2 * n_steps)
+        anchor_sync(final, fetch_all=True)
+        t1 = min(timed(run, n_steps) for _ in range(2))
+        t2 = min(timed(run, 2 * n_steps) for _ in range(2))
+        return ((t2 - t1) / n_steps if t2 > t1 else t1 / n_steps,
+                np.asarray(final), t2 > t1)
+
+    ovl_step, ovl_final, ovl_diff = per_step(run_ovl)
+    seq_step, seq_final, seq_diff = per_step(run_seq)
+    parity = np.array_equal(ovl_final, seq_final)
+    cells = edge * edge
+    fields.update({
+        "sharded_ab_parity": parity,
+        "sharded_overlap_cups": round(cells / ovl_step, 1),
+        "sharded_seq_cups": round(cells / seq_step, 1),
+        "vs_sequential": round(seq_step / ovl_step, 3),
+        "sharded_ab_is_differenced": ovl_diff and seq_diff,
+    })
+    if not parity:
+        fields["sharded_ab_error"] = (
+            "overlap final board diverged from the sequential schedule")
+        return fields
+
+    # Exchange-only microbench: the ghost ppermutes with no stencil
+    # behind them, same chained-differencing bracket. The concat keeps
+    # the collectives live in the loop (an unused ppermute is dead code
+    # XLA may elide); values shift per round, which is irrelevant — this
+    # is a pure timing probe on the production ghost shapes.
+    depth = plan_ovl.depth
+
+    def exch(block):
+        top, bot = haloplan.ghosts_y(block, depth)
+        return jnp.concatenate(
+            [bot, block[..., depth:-depth, :], top], axis=-2)
+
+    smapped = mesh_lib.shard_map(exch, mesh=mesh, in_specs=pspec,
+                                 out_specs=pspec, check_vma=False)
+
+    @jax.jit
+    def exch_n(b, n):
+        return lax.fori_loop(0, n, lambda _, c: smapped(c), b)
+
+    def exch_timed(n):
+        t0 = time.perf_counter()
+        anchor_sync(exch_n(dev_board, jnp.int32(n)), fetch_all=True)
+        return time.perf_counter() - t0
+
+    anchor_sync(exch_n(dev_board, jnp.int32(n_steps)), fetch_all=True)
+    x1 = min(exch_timed(n_steps) for _ in range(2))
+    x2 = min(exch_timed(2 * n_steps) for _ in range(2))
+    transfer_s = (x2 - x1) / n_steps if x2 > x1 else x1 / n_steps
+
+    # hidden = the seconds the overlap actually saved per round;
+    # exposed = the transfer remainder still on the critical path
+    # (clamped to the transfer itself: an overlap leg slower than seq
+    # exposed the whole exchange, not more than it).
+    hidden_s = max(0.0, seq_step - ovl_step)
+    exposed_s = min(transfer_s, max(0.0, transfer_s - hidden_s))
+    efficiency = (min(1.0, hidden_s / transfer_s)
+                  if transfer_s > 0 else 0.0)
+    fields.update({
+        "sharded_transfer_s": round(transfer_s, 8),
+        "sharded_exposed_s": round(exposed_s, 8),
+        "sharded_overlap_efficiency": round(efficiency, 4),
+    })
+    obs_trace.event("halo.ab", workload=spec.name, board=edge,
+                    halo=plan_ovl.engine,
+                    transfer_s=round(transfer_s, 8),
+                    exposed_s=round(exposed_s, 8),
+                    efficiency=round(efficiency, 4),
+                    vs_sequential=fields["vs_sequential"])
+    return fields
+
+
 def _autotune_phase(args, workload: str) -> dict:
     """The AUTOTUNE phase (``--autotune K``): install any persisted
     plans from the store first (validated + parity-gated), then either
@@ -811,6 +971,21 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
                 tuned = {"plan_source": "heuristic",
                          "tune_error": f"{type(e).__name__}: {e}"[:200]}
 
+    # The sharded halo A/B is workload-generic: heat/gray_scott/
+    # wireworld price their own overlap win through the same plan-
+    # scheduled engine legs.
+    sharded_ab = {}
+    if args.sharded_ab:
+        state["phase"] = "sharded_ab"
+        with obs_trace.span("bench.phase", phase="sharded_ab",
+                            workload=spec.name):
+            try:
+                sharded_ab = _sharded_ab_phase(args, spec.name)
+            except Exception as e:
+                sharded_ab = {"sharded_ab_board": args.sharded_board,
+                              "sharded_ab_error":
+                              f"{type(e).__name__}: {e}"[:200]}
+
     state["phase"] = "measure"
 
     def timed(n, reps=3):
@@ -858,6 +1033,7 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
         # heuristic unless the autotune phase overrides it below.
         "plan_source": "heuristic",
         **tuned,
+        **sharded_ab,
         **metrics_fields,
         **backend_note,
     }
@@ -881,7 +1057,8 @@ def main(argv=None) -> int:
                     "spec-engine headline (metric stencil_steady_cups_"
                     "<name>, same parity-gate + chained-differencing "
                     "discipline) and support --board/--steps/--trace/"
-                    "--ledger only — the life-specific phases "
+                    "--ledger/--autotune/--sharded-ab only — the "
+                    "life-specific phases "
                     "(--batch/--serve/--sessions/--checkpoint-dir/"
                     "--sparse-ab) are rejected")
     ap.add_argument("--sparse-ab", type=int, default=0, metavar="K",
@@ -894,6 +1071,23 @@ def main(argv=None) -> int:
                     "sparse_cups / dense_cups / sparse_vs_dense / "
                     "active_frac on the JSON line (runs on every "
                     "backend)")
+    ap.add_argument("--sharded-ab", type=int, default=0, metavar="K",
+                    help="also run the SHARDED HALO A/B (any workload): "
+                    "K torus steps of a --sharded-board² board through "
+                    "the plan-scheduled sharded engine (stencils.engine "
+                    "+ parallel.haloplan), overlap schedule vs forced-"
+                    "sequential baseline on the same mesh, both legs "
+                    "oracle-parity-gated, chain-differenced and required "
+                    "bit-identical, reporting sharded_overlap_cups / "
+                    "sharded_seq_cups / vs_sequential plus the exchange-"
+                    "only transfer-vs-exposed accounting on the JSON "
+                    "line (needs >= 2 devices — CI uses the 8-virtual-"
+                    "device CPU mesh; MOMP_HALO_OVERLAP=0 downgrades the "
+                    "sharded_halo stamp to seq:*, which the sentinel "
+                    "fails as a provenance downgrade)")
+    ap.add_argument("--sharded-board", type=int, default=512, metavar="N",
+                    help="board edge for the sharded halo A/B (default "
+                    "%(default)s; must divide across the mesh's y axis)")
     ap.add_argument("--sparse-board", type=int, default=2048, metavar="N",
                     help="board edge for the sparse A/B (default 2048; "
                     "must be a multiple of --sparse-tile)")
@@ -1012,6 +1206,9 @@ def main(argv=None) -> int:
                          "headline only")
     if args.autotune and args.autotune < 16:
         ap.error("--autotune needs >= 16 steps for the "
+                 "chained-differencing bracket")
+    if args.sharded_ab and args.sharded_ab < 16:
+        ap.error("--sharded-ab needs >= 16 steps for the "
                  "chained-differencing bracket")
     if args.sparse_ab:
         if args.sparse_ab < 16:
@@ -1342,6 +1539,20 @@ def _bench(args, state) -> int:
                 sparse = {"sparse_board": args.sparse_board,
                           "sparse_error": f"{type(e).__name__}: {e}"[:200]}
 
+    # Sharded halo-schedule A/B (opt-in via --sharded-ab K): overlap vs
+    # forced-sequential through the plan-scheduled engine. Same failure
+    # contract as the other opt-in phases.
+    sharded_ab = {}
+    if args.sharded_ab:
+        state["phase"] = "sharded_ab"
+        with obs_trace.span("bench.phase", phase="sharded_ab"):
+            try:
+                sharded_ab = _sharded_ab_phase(args, "life")
+            except Exception as e:
+                sharded_ab = {"sharded_ab_board": args.sharded_board,
+                              "sharded_ab_error":
+                              f"{type(e).__name__}: {e}"[:200]}
+
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device
     # serial dispatch, this measures what a user of the sharded API gets
@@ -1628,6 +1839,7 @@ def _bench(args, state) -> int:
         **tuned,
         **served,
         **sparse,
+        **sharded_ab,
         **sharded,
         **prof_fields,
         **trace_fields,

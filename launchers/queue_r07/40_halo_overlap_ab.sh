@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# r07 queued increment (ISSUE 15, DESIGN.md §17): persistent halo plans
+# on the real chip — the overlap-vs-sequential sharded A/B at the
+# acceptance geometries, then the same pass under MOMP_HALO_RDMA=1 so
+# the Pallas async-remote-copy ghost rung (overlap:rdma, row layout)
+# gets chip coverage the CPU CI cannot give it. On a single-device
+# topology the phase reports sharded_ab_error (needs >= 2 devices) and
+# the line still lands; on a ring it must stamp overlap:* provenance
+# with vs_sequential >= 1.0 and bit-exact parity between the two
+# schedules. Every line lands in MOMP_LEDGER (exported by
+# tpu_queue_loop.sh) under the halo-keyed baseline groups, so a later
+# run whose plan silently degrades to seq:* flags at the queue loop's
+# sentinel gate as a provenance downgrade. One chip process per bench
+# run, sequential; exits nonzero on failure so the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python bench.py --board 500 --steps 1000 --sharded-ab 64 --sharded-board 512
+
+python bench.py --board 2048 --steps 500 --sharded-ab 64 --sharded-board 2048
+
+MOMP_HALO_RDMA=1 python bench.py --board 500 --steps 500 \
+    --sharded-ab 64 --sharded-board 512

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import json
 import os
 import sys
 import time
@@ -76,6 +77,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also checkpoint every N steps, independent of the "
                         "save cadence (preemption-safe restart points; "
                         "SIGTERM flushes one and exits 75)")
+    p.add_argument("--plans", default=None, metavar="DIR",
+                   help="durable tuned-plan store (default "
+                        "$MOMP_TUNE_PLANS): records are validated + "
+                        "parity-gated and installed BEFORE the first "
+                        "dispatch, so a requeued exit-75 --resume run "
+                        "restarts both warm and tuned; the resume status "
+                        "line (stderr JSON) carries plan_source")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the run into DIR")
     p.add_argument("--trace", metavar="PATH", default=None,
@@ -115,6 +123,33 @@ def find_latest_checkpoint(ckpt_dir: str) -> tuple[str, int] | None:
     return _find_latest(ckpt_dir, r"step_(\d{6,})")
 
 
+def _plan_store(args):
+    """The durable tuned-plan store named by ``--plans`` /
+    ``MOMP_TUNE_PLANS``, or None (heuristics only)."""
+    plans_dir = args.plans or os.environ.get("MOMP_TUNE_PLANS") or None
+    if not plans_dir:
+        return None
+    from mpi_and_open_mp_tpu.tune.plans import PlanStore
+
+    return PlanStore(plans_dir)
+
+
+def _plan_fields(store, cfg, batch: int) -> dict:
+    """The ``plan_source`` stamp for the resume status line: ``store``
+    when the installed plans cover THIS (workload, stack shape) config,
+    ``heuristic`` otherwise (no store, a miss, or ``MOMP_TUNE=0`` — the
+    install was already skipped/quarantined upstream, so the lookup is
+    honestly empty)."""
+    fields = {"plan_source": "heuristic"}
+    if store is None:
+        return fields
+    hit = store.lookup("life", (max(batch, 1), cfg.ny, cfg.nx))
+    if hit is not None:
+        fields["plan_source"] = "store"
+        fields["tuned_path"] = hit["choice"]["path"]
+    return fields
+
+
 def make_mesh(args):
     if args.layout == "serial":
         return None
@@ -145,18 +180,27 @@ def _serve(args, cfg, parser) -> int:
             if args.checkpoint_dir else None)
     policy = ServePolicy(max_batch=args.batch or 8,
                          max_depth=max(64, 2 * args.serve))
+    # The daemon installs the store at construction, so EVERY resume
+    # rung comes up tuned before the first dispatch (ROADMAP autotune
+    # follow-on (c): a requeued exit-75 run restarts warm AND tuned).
+    store = _plan_store(args)
     if args.resume:
         if not ckpt:
             parser.error("--serve --resume needs --checkpoint-dir")
         try:
-            daemon = ServingDaemon.resume(ckpt, policy)
+            daemon = ServingDaemon.resume(ckpt, policy, plan_store=store)
         except ValueError as e:
             print(f"--serve --resume: {e}", file=sys.stderr)
             return 2
         print(f"resuming {daemon.queue.depth()} queued tickets from "
               f"{ckpt}", file=sys.stderr)
+        print(json.dumps({
+            "resumed": "serve_queue", "tickets": daemon.queue.depth(),
+            **_plan_fields(store, cfg, policy.max_batch)}),
+            file=sys.stderr)
     else:
-        daemon = ServingDaemon(policy, checkpoint_path=ckpt)
+        daemon = ServingDaemon(policy, checkpoint_path=ckpt,
+                               plan_store=store)
     board = cfg.board()
     for _ in range(args.serve):
         daemon.submit(board, cfg.steps)
@@ -227,6 +271,13 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
+    # Install tuned plans BEFORE the sim exists: the batched native
+    # engines consult them per dispatch, so a --resume with --plans (or
+    # MOMP_TUNE_PLANS in the queue loop's environment) restarts tuned,
+    # not just warm. Install summary + the per-config plan_source ride
+    # the stderr JSON status line the queue loop / tests read.
+    store = _plan_store(args)
+    plans_installed = store.install() if store is not None else None
     if args.resume:
         # Resume from whichever persisted state is newest (a stale
         # checkpoint dir must not roll back past newer VTK snapshots).
@@ -249,6 +300,11 @@ def main(argv=None) -> int:
                 )
             print(f"--resume: {' and '.join(sources)}", file=sys.stderr)
             return 2
+        print(json.dumps({
+            "resumed": os.path.basename(path), "step": step,
+            **({"plans_installed": plans_installed.get("installed", 0)}
+               if plans_installed is not None else {}),
+            **_plan_fields(store, cfg, args.batch)}), file=sys.stderr)
     elif args.batch:
         # B stacked copies of the cfg board: cups is content-independent
         # for a dense stencil, so identical copies time exactly what B

@@ -88,7 +88,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi_and_open_mp_tpu.ops import life_ops
-from mpi_and_open_mp_tpu.parallel import halo, mesh as mesh_lib
+from mpi_and_open_mp_tpu.parallel import halo, haloplan, mesh as mesh_lib
 from mpi_and_open_mp_tpu.utils import vtk as vtk_lib
 from mpi_and_open_mp_tpu.utils.config import LifeConfig
 
@@ -394,19 +394,24 @@ class LifeSim:
 
     # ---------------------------------------------------------- step builders
 
+    def _halo_plan(self, k: int) -> "haloplan.HaloPlan":
+        """The persistent exchange plan for one ``k``-step fused round
+        (derived once per geometry, ``lru_cache``d in ``haloplan``)."""
+        py, px = _mesh_divisors(self.layout, self.mesh)
+        return haloplan.plan_halo(
+            self.layout, (py, px),
+            (self.padded_shape[0] // py, self.padded_shape[1] // px),
+            self.spec.radius, k, channels=self.spec.channels,
+        )
+
     def _local_fused_step(self, block: jnp.ndarray, k: int) -> jnp.ndarray:
-        """Halo-pad a shard to depth ``k * radius`` and take ``k`` fused
-        local steps (each step consumes ``radius`` halo cells per side)."""
-        d = k * self.spec.radius
-        if self.layout == "row":
-            padded = halo.halo_pad_y(life_ops.pad_x_wrap(block, d), "y", d)
-        elif self.layout == "col":
-            padded = halo.halo_pad_x(life_ops.pad_y_wrap(block, d), "x", d)
-        else:  # cart
-            padded = halo.halo_pad_2d(block, "y", "x", d)
-        for _ in range(k):
-            padded = self._padded_step(padded)
-        return padded
+        """One fused round of ``k`` local steps (each consuming
+        ``radius`` halo cells per side), scheduled by the persistent
+        halo plan: ghost ``ppermute``s overlap the interior stencil when
+        the geometry allows (``parallel.haloplan``), else the historic
+        blocking ``halo_pad_*`` concat."""
+        return haloplan.fused_step(self._halo_plan(k), self._padded_step,
+                                   block)
 
     def _padded_step(self, padded: jnp.ndarray) -> jnp.ndarray:
         if self.impl == "pallas":
@@ -468,6 +473,10 @@ class LifeSim:
         # shard_map halo/pallas path, with k-step fusion per exchange round.
         spec = _layout_spec(self.layout, self.spec.channels)
         k = self.fuse_steps
+        # Provenance: the persistent plan's schedule stamp for the main
+        # round depth ("overlap:*" when the ghost exchange hides behind
+        # the interior stencil, "seq:halo" with the reason otherwise).
+        self.plan_note = self._halo_plan(k).engine
 
         def make_smapped(kk: int):
             # check_vma=False: the Pallas per-shard kernel can't annotate
@@ -585,8 +594,31 @@ class LifeSim:
 
             return advance
 
-        self.plan_note = plan.mode
+        # Packed overlap: window-mode exact-frame row shards split each
+        # round into interior (the raw slab is its own window — the outer
+        # h words play the halo role) and two 3h-word edge extensions,
+        # so the ghost ppermute flies while the interior kernel runs —
+        # one halo word carries 32 board rows, the overlap win
+        # multiplied (parallel.haloplan module docs). The haloplan
+        # carries the env kill switch + degenerate-geometry gates; depth
+        # is the full 32h-bit-row fuse budget of one exchange round.
+        eligible = bitlife.plan_overlap_supported(plan)
+        hp = (
+            haloplan.plan_halo(
+                "row", (plan.py, plan.px), (32 * plan.nw_s, plan.W),
+                32 * plan.h, 1, pack_layout="packed")
+            if eligible else None
+        )
+        use_overlap = hp is not None and hp.overlap
+        # 1-shard / ineligible geometry keeps the bare mode string (the
+        # historical note); capable geometry appends the schedule stamp.
+        self.plan_note = (
+            f"{plan.mode}+{hp.engine}" if hp is not None else plan.mode
+        )
         step_call = bitlife.make_plan_stepper(plan, interpret=interpret)
+        if use_overlap:
+            interior_call, edge_call = bitlife.make_overlap_steppers(
+                plan, interpret=interpret)
 
         def shard_fn(block, n):
             packed = bitlife.pack_board_exact(block)
@@ -594,6 +626,20 @@ class LifeSim:
             def body(carry):
                 q, rem = carry
                 k = jnp.minimum(rem, plan.k_max)
+                kk = k.reshape(1)
+                if use_overlap:
+                    # Ghosts issued first, consumed last: the interior
+                    # window reads only local words, so XLA's scheduler
+                    # pairs the permute-start with a done after it.
+                    haloplan._note_schedule(hp)
+                    top, bot = haloplan.packed_ghosts_y(q, plan.h)
+                    mid = interior_call(kk, q)
+                    lead = edge_call(
+                        kk, jnp.concatenate([top, q[: 2 * plan.h]]))
+                    tail = edge_call(
+                        kk, jnp.concatenate([q[-2 * plan.h:], bot]))
+                    out = jnp.concatenate([lead, mid, tail])
+                    return out, rem - k
                 # The packed, k_max-amortised ghost exchange: the same
                 # ring halos as every other impl, in word rows / lane
                 # columns (cf. 3-life/life_mpi.c:203-207, 4-life:197-208).

@@ -56,10 +56,14 @@ ENV = "MOMP_LEDGER"
 #: exactly what they ran. ``plan`` joined in PR 14 (the autotuner): a
 #: line measured under a persisted/tuned plan ({store, fresh}) and a
 #: heuristic-routed line are different dispatch decisions — the sentinel
-#: treats tuned -> heuristic as a provenance downgrade.
+#: treats tuned -> heuristic as a provenance downgrade. ``halo`` joined
+#: in PR 15 (persistent halo plans): the sharded halo schedule stamp
+#: ({overlap:*, seq:*}) — the sentinel treats overlap -> seq as a
+#: provenance downgrade (the kill switch silently left on is exactly the
+#: regression this catches).
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
               "batch_pack_layout", "resident", "workload", "plan",
-              "engine")
+              "halo", "engine")
 
 _GIT_SHA: str | None = None
 
@@ -126,6 +130,9 @@ def stamp(record: dict, *, source: str = "bench.py",
         # "-" for lines that never consulted the autotuner; tuned lines
         # carry the closed vocabulary {heuristic, fresh, store}.
         "plan": record.get("plan_source", "-"),
+        # "-" for lines without a sharded A/B; scheduled lines carry the
+        # haloplan engine stamp ({overlap:*, seq:*}).
+        "halo": record.get("sharded_halo", "-"),
         "engine": record.get("impl", "?"),
     }
     return {
@@ -177,7 +184,7 @@ def load(path: str) -> list[dict]:
 #: "unrecorded": entries stamped before the field joined KEY_FIELDS must
 #: keep matching new lines that carry the explicit "-" placeholder.
 _KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-",
-                 "workload": "life", "plan": "-"}
+                 "workload": "life", "plan": "-", "halo": "-"}
 
 
 def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:

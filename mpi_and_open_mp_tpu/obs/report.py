@@ -111,6 +111,29 @@ def _attention(records: list[dict]) -> dict:
     }
 
 
+def _halo(records: list[dict]) -> dict:
+    """The sharded halo-schedule summary: ``halo.overlap``/``halo.seq``
+    span counts with the engine stamps seen on each, plus the exposed-
+    vs-hidden transfer accounting from the LAST ``halo.ab`` event
+    (``bench._sharded_ab_phase`` emits one per A/B: measured transfer
+    seconds per round, the exposed remainder the overlap failed to hide,
+    and their ratio as overlap efficiency)."""
+    overlap = _spans(records, "halo.overlap")
+    seq = _spans(records, "halo.seq")
+    engines = sorted({(s.get("attrs") or {}).get("engine", "?")
+                      for s in overlap + seq})
+    ab = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "halo.ab":
+            ab = dict(r.get("attrs") or {})
+    return {
+        "overlap_spans": len(overlap),
+        "seq_spans": len(seq),
+        "engines": engines,
+        "ab": ab,
+    }
+
+
 def _recoveries(records: list[dict]) -> dict:
     by_stamp: dict[str, int] = {}
     for r in records:
@@ -144,6 +167,7 @@ def report_dict(records: list[dict]) -> dict:
         "records": len(records),
         "phases": _phase_breakdown(records),
         "attention": _attention(records),
+        "halo": _halo(records),
         "recoveries": _recoveries(records),
         "retraces": _retraces(records),
     }
@@ -242,6 +266,18 @@ def render(rep: dict) -> str:
                   else "unidentifiable(beta<=0)")
             lines.append(f"hop fit: alpha={f['alpha_us']}us bandwidth={bw} "
                          f"r2={f['r2']}")
+    hal = rep.get("halo") or {}
+    if hal.get("overlap_spans") or hal.get("seq_spans"):
+        lines.append("")
+        lines.append(
+            f"halo: {hal['overlap_spans']} overlap + {hal['seq_spans']} "
+            f"seq schedule spans, engines: {', '.join(hal['engines'])}")
+        ab = hal.get("ab")
+        if ab:
+            lines.append(
+                f"halo A/B: transfer={ab.get('transfer_s', 0):.6f}s/round "
+                f"exposed={ab.get('exposed_s', 0):.6f}s "
+                f"efficiency={ab.get('efficiency', 0):.1%}")
     rec = rep["recoveries"]
     if rec["total"]:
         lines.append("")

@@ -809,6 +809,55 @@ def make_plan_stepper(plan: BitPlan, *, interpret: bool = False):
     )
 
 
+def plan_overlap_supported(plan: BitPlan) -> bool:
+    """Whether the plan's geometry admits the interior/boundary overlap
+    split (``parallel.haloplan``): window-mode row shards with an EXACT
+    word frame. ``pad_y > 0`` frames exchange funnel-shifted unaligned
+    ranges and refresh mirrors — a sequencing the split would have to
+    replicate in every partition for no interior gain — and an x-sharded
+    plan's y ghosts must ride AFTER the x exchange (corners), so both
+    stay on the sequential schedule. ``nw_s > 2h`` keeps the interior
+    partition non-empty; 1-shard meshes are the caller's degenerate
+    gate (nothing to overlap)."""
+    return (plan.mode == "window" and plan.y_sharded
+            and not plan.x_sharded and plan.pad_y == 0
+            and plan.nw_s > 2 * plan.h)
+
+
+def make_overlap_steppers(plan: BitPlan, *, interpret: bool = False):
+    """``(interior_call, edge_call)`` for the overlapped packed round —
+    gate on :func:`plan_overlap_supported`.
+
+    * ``interior_call(k, q) -> (nw_s - 2h, W)``: the RAW packed shard is
+      its own window — the outer ``h`` words per side play the halo role
+      — so word rows ``[h, nw_s - h)`` compute from purely local data
+      while the ghost ``ppermute`` flies.
+    * ``edge_call(k, ext3h) -> (h, W)``: a ``3h``-word extension
+      (``concat([ghost, q[:2h]])`` / ``(q[-2h:], ghost)``) yields the
+      edge partition once the ghost lands.
+
+    Soundness is the window path's own argument: roll-wrap garbage
+    enters a window edge and walks ONE bit row per fused step, and every
+    valid output bit row sits ``32h >= k_max >= k`` rows from the
+    nearest edge — in all three programs. The per-word carry-save ops
+    are position-identical to the sequential window's, so the
+    reassembled ``concat([edge, interior, edge])`` is bit-exact to
+    ``make_plan_stepper``'s result (fuzzed in ``tests/test_haloplan.py``).
+    One halo word carries 32 board rows: the overlap win multiplied by
+    the packing density."""
+    if not plan_overlap_supported(plan):
+        raise ValueError(f"plan admits no overlap split: {plan}")
+    interior = make_window_stepper(
+        plan.nw_s - 2 * plan.h, plan.W, h=plan.h, halo_x=0,
+        nx_exact=plan.nx_exact, interpret=interpret,
+    )
+    edge = make_window_stepper(
+        plan.h, plan.W, h=plan.h, halo_x=0,
+        nx_exact=plan.nx_exact, interpret=interpret,
+    )
+    return interior, edge
+
+
 @functools.partial(
     jax.jit, static_argnames=("interpret", "tile_budget_bytes")
 )

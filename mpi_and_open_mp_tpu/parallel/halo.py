@@ -50,9 +50,12 @@ def _chaos_ghost(ghost: jnp.ndarray) -> jnp.ndarray:
     ``MOMP_CHAOS`` halo fault the ghost block passes through untouched and
     no injection ops enter the program — this body runs only while
     tracing, so the check costs nothing per step. A corrupted/dropped
-    ghost here is what the ``LifeSim`` consistency probe must catch (the
-    packed ``pad > 0`` frame paths funnel through their own slicing and
-    are exercised on the un-padded degenerate route only)."""
+    ghost here is what the ``LifeSim`` consistency probe must catch.
+    Every ghost route funnels through this hook — including the packed
+    ``pad > 0`` frame paths, which wrap their INCOMING ghost block only
+    (the same-direction permute also refreshes the wrap shard's mirror
+    region from live data; corrupting that write would alter real board
+    state, which chaos must never do)."""
     from mpi_and_open_mp_tpu.robust import chaos
 
     spec = chaos.halo_ghost_spec()
@@ -133,7 +136,10 @@ def packed_halo_y(
     _note_exchange("packed_y", axis_name)
     p = _axis_size(axis_name)
     s = h + 1 + pad // 32
-    up = lax.ppermute(e[-s:], axis_name, ring_perm(p, 1))
+    # Chaos wraps the INCOMING top ghost only (injection-point parity
+    # with halo_pad_y): `dn` also refreshes the wrap shard's mirror
+    # rows from live data, a write chaos must never corrupt.
+    up = _chaos_ghost(lax.ppermute(e[-s:], axis_name, ring_perm(p, 1)))
     dn = lax.ppermute(e[:s], axis_name, ring_perm(p, -1))
     i = lax.axis_index(axis_name)
     # Shard 0's top ghost is board rows [ny-32h, ny) — an unaligned range
@@ -168,7 +174,10 @@ def packed_halo_x(
     _note_exchange("packed_x", axis_name)
     p = _axis_size(axis_name)
     s = hx + pad
-    left = lax.ppermute(block[:, -s:], axis_name, ring_perm(p, 1))
+    # Chaos on the incoming left ghost only — `right` also feeds the
+    # wrap shard's mirror-column refresh (see packed_halo_y).
+    left = _chaos_ghost(
+        lax.ppermute(block[:, -s:], axis_name, ring_perm(p, 1)))
     right = lax.ppermute(block[:, :s], axis_name, ring_perm(p, -1))
     i = lax.axis_index(axis_name)
     lb = jnp.where(i == 0, left[:, :hx], left[:, pad:])

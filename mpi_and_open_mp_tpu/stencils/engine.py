@@ -230,3 +230,142 @@ def run_padded_pallas_batch(spec: StencilSpec, stack, n: int):
     jnp interior step inside the same loop — the caller never has to
     re-plan. Gate callers on :func:`pallas_batch_supported`."""
     return _run_padded_pallas_batch_jit(spec)(stack, n)
+
+
+# ------------------------------------------------------- sharded halo steps
+#
+# The engine-level sharded entry: shard_map halo rounds driven by a
+# persistent HaloPlan (parallel.haloplan) — the overlap/sequential
+# schedule decision is the PLAN's, derived once per geometry, so the
+# tuner, the bench A/B and the model layer all measure the same two
+# schedules instead of three ad-hoc code paths.
+
+
+def _sharded_pspec(layout: str, channels: int):
+    """PartitionSpec for a (channels-leading) board under ``layout`` —
+    the engine-side twin of ``models.life._layout_spec``."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = {"row": ("y", None), "col": (None, "x"),
+            "cart": ("y", "x")}[layout]
+    return P(None, *axes) if channels > 1 else P(*axes)
+
+
+def mesh_axes_for(layout: str, mesh) -> tuple[int, int]:
+    """(py, px) shard counts per board axis under ``layout``."""
+    py = mesh.shape.get("y", 1) if layout in ("row", "cart") else 1
+    px = mesh.shape.get("x", 1) if layout in ("col", "cart") else 1
+    return py, px
+
+
+def fused_steps_valid(spec: StencilSpec, shard_shape: tuple[int, int],
+                      fuse_steps: int) -> bool:
+    """Whether ``fuse_steps`` legal-fuses on this shard: the halo depth
+    ``fuse_steps * radius`` cannot exceed the smallest shard extent (a
+    halo deeper than the shard it pads would wrap a neighbour's
+    neighbour)."""
+    return fuse_steps * spec.radius <= min(shard_shape)
+
+
+def make_sharded_runner(spec: StencilSpec, mesh, layout: str,
+                        shape: tuple[int, int], *, fuse_steps: int = 1,
+                        overlap: bool | None = None):
+    """Build ``(run, plan)`` for a sharded board: ``run(board, n)``
+    advances ``n`` torus steps via plan-scheduled shard_map halo rounds.
+
+    ``overlap=None`` lets the plan decide (geometry + the
+    ``MOMP_HALO_OVERLAP`` kill switch); ``False`` forces the sequential
+    schedule — the A/B baseline leg — and stamps ``why`` accordingly.
+    ``run`` is jit-cached per static ``n`` (remainder rounds get their
+    own smaller-depth plan, which may legally degrade to sequential
+    even when the main rounds overlap).
+    """
+    import dataclasses as _dc
+    import functools as _ft
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_and_open_mp_tpu.parallel import haloplan, mesh as mesh_lib
+
+    ny, nx = shape
+    py, px = mesh_axes_for(layout, mesh)
+    if ny % py or nx % px:
+        raise ValueError(
+            f"board {shape} does not divide mesh {dict(mesh.shape)} "
+            f"under layout={layout!r}")
+    shard = (ny // py, nx // px)
+    if not fused_steps_valid(spec, shard, fuse_steps):
+        raise ValueError(
+            f"fuse_steps={fuse_steps} x radius {spec.radius} exceeds "
+            f"shard {shard}")
+
+    def plan_for(k: int) -> "haloplan.HaloPlan":
+        p = haloplan.plan_halo(layout, (py, px), shard, spec.radius, k,
+                               channels=spec.channels)
+        if overlap is False and p.overlap:
+            p = _dc.replace(p, overlap=False, engine="seq:halo",
+                            why="forced sequential (A/B baseline)")
+        return p
+
+    plan = plan_for(fuse_steps)
+    pspec = _sharded_pspec(layout, spec.channels)
+
+    def step_fn(padded):
+        return step_padded(spec, padded, jnp)
+
+    def make_smapped(k: int):
+        pk = plan_for(k)
+        return mesh_lib.shard_map(
+            lambda b: haloplan.fused_step(pk, step_fn, b),
+            mesh=mesh, in_specs=pspec, out_specs=pspec, check_vma=False)
+
+    smapped_k = make_smapped(fuse_steps)
+    smapped_cache = {fuse_steps: smapped_k}
+
+    @_ft.partial(jax.jit, static_argnums=1)
+    def run(board, n):
+        rounds, rem = divmod(n, fuse_steps)
+        board = lax.fori_loop(0, rounds, lambda _, b: smapped_k(b), board)
+        if rem:
+            if rem not in smapped_cache:
+                smapped_cache[rem] = make_smapped(rem)
+            board = smapped_cache[rem](board)
+        return board
+
+    return run, plan
+
+
+def run_sharded(spec: StencilSpec, board, n: int, *, mesh,
+                layout: str = "row", fuse_steps: int = 1,
+                overlap: bool | None = None):
+    """Advance ``n`` sharded steps under a ``halo.overlap`` /
+    ``halo.seq`` trace span (host-level: the span brackets dispatch
+    through completion; schedule hooks never enter the jitted program).
+    Places the board on the mesh if the caller has not. Returns the
+    advanced board; the plan rides on ``run_sharded.last_plan`` for
+    provenance stamping."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from mpi_and_open_mp_tpu.obs import trace
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    run, plan = make_sharded_runner(
+        spec, mesh, layout, tuple(board.shape[-2:]),
+        fuse_steps=fuse_steps, overlap=overlap)
+    run_sharded.last_plan = plan
+    sharding = NamedSharding(mesh, _sharded_pspec(layout, spec.channels))
+    board = jax.device_put(jnp.asarray(board, spec.dtype), sharding)
+    name = "halo.overlap" if plan.overlap else "halo.seq"
+    with trace.span(name, engine=plan.engine, layout=layout,
+                    workload=spec.name, steps=int(n),
+                    fuse_steps=int(fuse_steps)):
+        out = run(board, int(n))
+        anchor_sync(out)
+    return out
+
+
+run_sharded.last_plan = None

@@ -25,10 +25,12 @@ from .plans import (  # noqa: F401
     load_plan,
     save_plan,
 )
-from .runner import tune  # noqa: F401
+from .runner import tune, tune_sharded  # noqa: F401
 from .space import (  # noqa: F401
     Candidate,
+    axis_orders,
     candidates,
     heuristic_path,
     runner_for,
+    sharded_candidates,
 )

@@ -72,6 +72,11 @@ def fingerprint_for(workload: str, shape, dtype, path: str) -> dict:
     from mpi_and_open_mp_tpu.ops import pallas_life
 
     shape = tuple(int(x) for x in shape)
+    if len(shape) == 2:
+        # Board-shape (sharded-schedule) plans fingerprint as a
+        # stack-of-one: same digest machinery, and the pinned
+        # "sharded:*" path keeps them disjoint from batched plans.
+        shape = (1, *shape)
     pin = str(path) if workload == "life" else None
     with pallas_life._planned_pinned("life", shape, pin):
         return aotcache.fingerprint(shape, dtype, workload=str(workload))
@@ -224,11 +229,24 @@ class PlanStore:
                 self._note("stale", path=path, quarantined=q or "",
                            error=f"fingerprint drift: {drift}"[:200])
                 continue
-            if parity_gate and not self._parity_ok(record, path):
-                summary["parity_rejected"] += 1
-                continue
-            pallas_life.install_planned_path(workload, shape, engine)
-            self._installed[pallas_life._plan_key(workload, shape)] = record
+            if engine.startswith("sharded:"):
+                # Sharded-schedule records (tune.runner.tune_sharded):
+                # no batched engine to pin — the choice is an
+                # (axis_order, halo schedule) pair the sharded runner
+                # consults via lookup_sharded(). Parity-gated through
+                # the sharded runner itself on the record's own mesh.
+                if parity_gate and not self._sharded_parity_ok(
+                        record, path):
+                    summary["parity_rejected"] += 1
+                    continue
+                self._installed[("sharded", workload, shape)] = record
+            else:
+                if parity_gate and not self._parity_ok(record, path):
+                    summary["parity_rejected"] += 1
+                    continue
+                pallas_life.install_planned_path(workload, shape, engine)
+                self._installed[
+                    pallas_life._plan_key(workload, shape)] = record
             summary["installed"] += 1
             summary["plans"].append({
                 "workload": workload, "shape": list(shape),
@@ -237,6 +255,49 @@ class PlanStore:
             self._note("installed", path=path, workload=workload,
                        engine=engine)
         return summary
+
+    def lookup_sharded(self, workload: str, shape) -> dict | None:
+        """The INSTALLED sharded-schedule record for (workload, BOARD
+        shape), or None."""
+        return self._installed.get(
+            ("sharded", str(workload), tuple(int(x) for x in shape)))
+
+    def _sharded_parity_ok(self, record: dict, plan_file: str) -> bool:
+        """Parity gate for a sharded-schedule record: rebuild the
+        choice's mesh and drive the sharded runner against the oracle.
+        The fingerprint gate already pinned the topology, so the mesh is
+        reconstructible here; any failure rejects the plan and the
+        un-tuned schedule serves unchanged."""
+        from mpi_and_open_mp_tpu import stencils
+        from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+        from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+
+        choice = record["choice"]
+        try:
+            workload = str(choice["workload"])
+            ny, nx = (int(x) for x in choice["shape"])
+            py, px = (int(x) for x in choice["mesh_axes"])
+            spec = stencils.get(workload)
+            mesh = mesh_lib.make_mesh_2d(py, px)
+            board = spec.init(np.random.default_rng(_PARITY_SEED),
+                              (ny, nx))
+            out = stencil_engine.run_sharded(
+                spec, board, PARITY_STEPS, mesh=mesh,
+                layout=str(choice["axis_order"]),
+                overlap=(None if choice.get("halo_overlap") == "overlap"
+                         else False))
+            ok = stencils.parity_ok(
+                spec, np.asarray(out),
+                stencils.oracle_run(spec, board, PARITY_STEPS))
+        except Exception as e:  # noqa: BLE001 — rejection, never a crash
+            ok = False
+            self._note("parity_error", path=plan_file,
+                       error=f"{type(e).__name__}: {e}"[:200])
+        if not ok:
+            q = checkpoint_mod.quarantine(plan_file, label="parity")
+            self._note("parity_rejected", path=plan_file,
+                       quarantined=q or "")
+        return ok
 
     def _parity_ok(self, record: dict, plan_file: str) -> bool:
         """Prove the plan's engine against the NumPy oracle before it

@@ -178,3 +178,154 @@ def tune(workload: str, shape, *, steps: int = 64, store=None,
     trace.event("tune.done", workload=str(workload),
                 path=best["path"], vs_heuristic=vs or 0.0)
     return result
+
+
+def tune_sharded(workload: str, shape, *, mesh=None, steps: int = 32,
+                 store=None, reps: int = 2, mult: int = 5,
+                 parity_steps: int = plans_mod.PARITY_STEPS) -> dict:
+    """One bounded SHARDED tuning pass for (workload, board shape):
+    profile every legal (axis_order, halo schedule) candidate on a real
+    >=2-device mesh — the measured form of PAPERS.md's process-mapping
+    axis, which single-device profiling could only enumerate. Same
+    discipline as :func:`tune`: oracle parity FIRST, chain-differenced
+    brackets, the historic schedule (seq) is always in the race and ties
+    keep it. 1-D meshes are legality-gated per layout by
+    ``space.sharded_candidates`` (a mesh that shards nothing under a
+    layout simply does not list it); a mesh with no legal candidate at
+    all raises rather than reporting an empty win."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.obs import metrics, trace
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+    from mpi_and_open_mp_tpu.serve import aotcache
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    if mesh is None:
+        mesh = mesh_lib.make_mesh_2d()
+    shape = tuple(int(x) for x in shape)
+    ny, nx = shape
+    spec = stencils.get(workload)
+    board = spec.init(np.random.default_rng(_TUNE_SEED), (ny, nx))
+    want = stencils.oracle_run(spec, board, parity_steps)
+    cells = ny * nx
+    cands = space.sharded_candidates(workload, shape, mesh)
+    if not cands:
+        raise RuntimeError(
+            f"no legal sharded candidate for {workload} {shape} on mesh "
+            f"{dict(mesh.shape)} (1-shard axes and non-dividing layouts "
+            "are gated out)")
+    # Baseline-first: the historic sequential schedule on the first
+    # legal layout opens the race, so ties keep it (strict < below).
+    cands = sorted(cands, key=lambda c: c.halo_overlap != "seq")
+
+    measurements, rejected = [], []
+    for cand in cands:
+        layout = cand.axis_order
+        ovl = None if cand.halo_overlap == "overlap" else False
+        with trace.span("tune.candidate", workload=str(workload),
+                        path=cand.path, axis_order=layout,
+                        halo_overlap=cand.halo_overlap):
+            try:
+                run, plan = stencil_engine.make_sharded_runner(
+                    spec, mesh, layout, shape, fuse_steps=1, overlap=ovl)
+                sharding = NamedSharding(
+                    mesh, stencil_engine._sharded_pspec(
+                        layout, spec.channels))
+                dev = jax.device_put(
+                    jnp.asarray(board, spec.dtype), sharding)
+                got = np.asarray(run(dev, int(parity_steps)))
+                ok = stencils.parity_ok(spec, got, want)
+            except Exception as e:  # noqa: BLE001 — rejection, not crash
+                metrics.inc("tune.candidate", status="error")
+                rejected.append({
+                    "path": cand.path,
+                    "halo_overlap": cand.halo_overlap,
+                    "reason": f"{type(e).__name__}: {e}"[:200]})
+                continue
+            if not ok:
+                metrics.inc("tune.candidate", status="parity_rejected")
+                rejected.append({"path": cand.path,
+                                 "halo_overlap": cand.halo_overlap,
+                                 "reason": "parity"})
+                continue
+            anchor_sync(run(dev, int(steps)))
+
+            def timed(n):
+                best_t = float("inf")
+                for _ in range(max(1, int(reps))):
+                    t0 = time.perf_counter()
+                    anchor_sync(run(dev, int(n)))
+                    best_t = min(best_t, time.perf_counter() - t0)
+                return best_t
+
+            t1, t2 = timed(steps), timed(steps * mult)
+            differenced = t2 > t1
+            steady = ((t2 - t1) / (steps * (mult - 1)) if differenced
+                      else t1 / steps)
+            metrics.inc("tune.candidate", status="timed")
+            measurements.append({
+                "path": cand.path,
+                "axis_order": layout,
+                "halo_overlap": cand.halo_overlap,
+                "engine": plan.engine,
+                "steady_s_per_step": steady,
+                "cups": round(cells / steady, 1),
+                "is_differenced": differenced,
+            })
+    if not measurements:
+        raise RuntimeError(
+            f"sharded autotune found no parity-clean candidate for "
+            f"{workload} {shape} (rejected: {rejected})")
+    best = measurements[0]
+    for m in measurements[1:]:
+        if m["steady_s_per_step"] < best["steady_s_per_step"]:
+            best = m
+    baseline = measurements[0]  # seq leg, sort above
+    vs = round(baseline["steady_s_per_step"]
+               / best["steady_s_per_step"], 3)
+
+    py, px = (mesh.shape.get("y", 1), mesh.shape.get("x", 1))
+    result = {
+        "workload": str(workload),
+        "shape": list(shape),
+        "dtype": str(spec.np_dtype),
+        "mesh_axes": [py, px],
+        "steps_budget": int(steps),
+        "baseline": baseline,
+        "tuned": best,
+        "vs_sequential": vs,
+        "measurements": measurements,
+        "rejected": rejected,
+    }
+    if store is not None:
+        key = plans_mod.fingerprint_for(
+            workload, shape, spec.np_dtype, best["path"])
+        record = {
+            "schema": plans_mod.PLAN_SCHEMA,
+            "key": key,
+            "choice": {
+                "workload": str(workload), "shape": list(shape),
+                "dtype": str(spec.np_dtype), "path": best["path"],
+                "pack_layout": "-",
+                "bucket_rounding": space.BUCKET_POW2,
+                "axis_order": best["axis_order"],
+                "halo_overlap": best["halo_overlap"],
+                "mesh_axes": [py, px],
+            },
+            "heuristic": baseline,
+            "tuned": best,
+            "vs_heuristic": vs,
+            "steps_budget": int(steps),
+            "measurements": measurements,
+            "rejected": rejected,
+        }
+        result["plan_file"] = store.save(record)
+        result["digest"] = aotcache.digest_for(key)
+    trace.event("tune.sharded.done", workload=str(workload),
+                path=best["path"], axis_order=best["axis_order"],
+                halo_overlap=best["halo_overlap"], vs_sequential=vs)
+    return result

@@ -49,13 +49,63 @@ class Candidate:
     bucket_rounding: str
     #: Decomposition axis order (single-process profiling: "row").
     axis_order: str = "row"
+    #: Halo schedule for sharded candidates ("overlap"/"seq"); "-" for
+    #: single-device paths, where there is no exchange to schedule.
+    halo_overlap: str = "-"
 
 
-def axis_orders(device_count: int = 1) -> tuple[str, ...]:
+def axis_orders(device_count: int = 1,
+                mesh_axes: tuple[int, int] | None = None) -> tuple[str, ...]:
     """Legal decomposition axis orders for a topology. One device has
-    exactly one (nothing to decompose); multi-device meshes list the
-    column order too so a future multi-chip profile pass can time it."""
-    return ("row",) if int(device_count) <= 1 else ("row", "col")
+    exactly one (nothing to decompose); multi-device meshes add the
+    column order, and a REAL 2-D mesh (both axis sizes > 1 — pass
+    ``mesh_axes``) adds the Cartesian block order, the axis PAPERS.md's
+    process-mapping result actually varies."""
+    if int(device_count) <= 1:
+        return ("row",)
+    orders = ("row", "col")
+    if mesh_axes is not None:
+        py, px = (int(a) for a in mesh_axes)
+        if py > 1 and px > 1:
+            orders = ("row", "col", "cart")
+    return orders
+
+
+def sharded_candidates(workload: str, shape: tuple[int, int],
+                       mesh) -> list[Candidate]:
+    """Every legal sharded-halo candidate for (workload, BOARD shape)
+    on ``mesh``: axis order x halo schedule, legality-filtered the same
+    way the batched space is — a layout is listed only if the board
+    divides the mesh under it AND the mesh actually shards that layout's
+    axes (a 1-D y mesh lists no "col"/"cart": they would shard nothing),
+    and the "overlap" leg only where the persistent plan accepts the
+    geometry (``parallel.haloplan``; the "seq" leg is always legal, so
+    the historic schedule is always in the race — the sharded twin of
+    heuristic-first)."""
+    from mpi_and_open_mp_tpu.parallel import haloplan
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+
+    spec = stencils.get(workload)
+    ny, nx = (int(x) for x in shape)
+    mesh_axes = (mesh.shape.get("y", 1), mesh.shape.get("x", 1))
+    out = []
+    for layout in axis_orders(mesh.size, mesh_axes):
+        py, px = stencil_engine.mesh_axes_for(layout, mesh)
+        if py * px <= 1 or ny % py or nx % px:
+            continue
+        shard = (ny // py, nx // px)
+        if not stencil_engine.fused_steps_valid(spec, shard, 1):
+            continue
+        plan = haloplan.plan_halo(layout, (py, px), shard, spec.radius, 1,
+                                  channels=spec.channels)
+        schedules = ("overlap", "seq") if plan.overlap else ("seq",)
+        for sched in schedules:
+            out.append(Candidate(
+                workload=str(workload), path=f"sharded:{layout}",
+                pack_layout="-", bucket_rounding=BUCKET_POW2,
+                axis_order=layout, halo_overlap=sched))
+    return out
 
 
 def life_paths(shape: tuple[int, int, int], on_tpu: bool) -> list[str]:
