@@ -129,6 +129,17 @@ WATCH_FIELDS = (
     # the interior stencil.
     "sharded_overlap_cups",
     "vs_sequential",
+    # Sparse x sharded (PR 16): the composed engine's rate and its
+    # ratios over the dense sharded schedule and the single-device
+    # sparse engine, measured in the same process (RTT- and noise-
+    # cancelled, like vs_sequential) — all higher-is-better by the
+    # cups/vs naming rules. vs_dense sliding toward 1.0 means per-round
+    # cost stopped tracking the live area; vs_single sliding down means
+    # the mesh stopped paying for itself. ``active_frac`` stays
+    # unwatched here for the same reason as PR 13's.
+    "sparse_sharded_cups",
+    "sparse_sharded_vs_dense",
+    "sparse_sharded_vs_single",
 )
 
 
@@ -158,7 +169,7 @@ def direction_for(field: str) -> str:
 PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
                      "attention_engine", "attention_hop_engine",
                      "attention_hop_engine_bwd", "sparse_engine",
-                     "sharded_halo")
+                     "sharded_halo", "sparse_sharded_engine")
 
 #: ``workload`` joined in PR 13: a heat line and a life line of the same
 #: shape are different rules — they must never share a baseline group

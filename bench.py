@@ -857,6 +857,146 @@ def _sharded_ab_phase(args, workload: str) -> dict:
     return fields
 
 
+def _sparse_sharded_ab_phase(args) -> dict:
+    """The SPARSE x SHARDED A/B (``--sparse-sharded-ab K``): K Life
+    steps of the mostly-dead ``--sparse-board``² seed board through
+    ``stencils.sparse_sharded.SparseShardedEngine`` on the row mesh,
+    versus (a) the dense sharded runner on the SAME mesh and (b) the
+    single-device ``ActiveTileEngine`` — the composition this engine
+    exists for, measured against both parents. Honesty discipline is
+    the union of the parents': the sparse-sharded leg is oracle-parity-
+    gated first (8 steps), its full-run final board must be
+    BIT-identical to the dense sharded schedule's, every leg is
+    chain-differenced (K and 2K) from warm state with min-of-2
+    brackets, and fresh engines open every host-driven bracket (mask
+    state is the engine — reuse would grade a warmer mask). The
+    ``sparse_sharded_engine`` stamp is what the run resolved to
+    (``sparse-sharded:row:t<tile>``, or ``dense:*`` when the crossover
+    or the ``MOMP_SPARSE_SHARDED=0`` kill switch forced dense rounds —
+    the ledger keys on it and the sentinel fails the downgrade), and
+    the exchange_rounds/exchange_skips counters ride the line so a
+    recorded win shows how many rounds shipped no ghost payload."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.parallel import mesh as mesh_lib
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+    from mpi_and_open_mp_tpu.stencils.sparse import ActiveTileEngine
+    from mpi_and_open_mp_tpu.stencils.sparse_sharded import (
+        SparseShardedEngine)
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    n_steps, edge, tile = (args.sparse_sharded_ab, args.sparse_board,
+                           args.sparse_tile)
+    spec = stencils.get("life")
+    fields = {"sparse_sharded_board": edge,
+              "sparse_sharded_steps": n_steps,
+              "sparse_sharded_tile": tile}
+    if jax.device_count() < 2:
+        fields["sparse_sharded_error"] = (
+            "needs >= 2 devices (cross-shard activation engages from 2 "
+            "shards); CI runs it under the 8-virtual-device CPU mesh")
+        return fields
+    mesh = mesh_lib.make_mesh_1d()  # every device on y: row layout
+    py = mesh.shape.get("y", 1)
+    if edge % py or (edge // py) % tile:
+        fields["sparse_sharded_error"] = (
+            f"--sparse-board {edge} does not tile the {py}-way mesh "
+            f"at --sparse-tile {tile}")
+        return fields
+    board = _sparse_seed_board(edge, tile)
+
+    def fresh():
+        return SparseShardedEngine(spec, board, mesh=mesh, layout="row",
+                                   tile=tile)
+
+    # Oracle gate on the sparse-sharded leg (8 steps), before any
+    # number is recorded.
+    eng8 = fresh()
+    eng8.step(8)
+    fields["sparse_sharded_engine"] = eng8.engine_stamp
+    if not np.array_equal(eng8.snapshot(),
+                          stencils.oracle_run(spec, board, 8)):
+        fields["sparse_sharded_error"] = (
+            "sparse-sharded engine failed oracle parity")
+        return fields
+
+    # Dense sharded leg: the same mesh, the same schedule family the
+    # sparse rounds gather from — warm both static-n programs, then
+    # chain-difference with min-of-2.
+    run_dense, _plan = stencil_engine.make_sharded_runner(
+        spec, mesh, "row", (edge, edge))
+    dev_board = jax.device_put(
+        jnp.asarray(board, spec.dtype),
+        NamedSharding(mesh, stencil_engine.sharded_pspec(
+            "row", spec.channels)))
+
+    def dense_timed(n):
+        t0 = time.perf_counter()
+        anchor_sync(run_dense(dev_board, n), fetch_all=True)
+        return time.perf_counter() - t0
+
+    anchor_sync(run_dense(dev_board, n_steps), fetch_all=True)
+    dense_final = run_dense(dev_board, 2 * n_steps)
+    anchor_sync(dense_final, fetch_all=True)
+    dense_final = np.asarray(dense_final)
+    d1 = min(dense_timed(n_steps) for _ in range(2))
+    d2 = min(dense_timed(2 * n_steps) for _ in range(2))
+    dense_step = (d2 - d1) / n_steps if d2 > d1 else d1 / n_steps
+
+    # Sparse-sharded leg: fresh engine per bracket; one warm run first
+    # so the kcap-ladder programs are compiled outside the brackets.
+    def sparse_sharded_run(n):
+        eng = fresh()
+        t0 = time.perf_counter()
+        eng.step(n)
+        anchor_sync(eng.board, fetch_all=True)
+        return eng, time.perf_counter() - t0
+
+    # Warm the FULL 2K trajectory: the rung ladder is trajectory-
+    # dependent, and a rung first reached between K and 2K would
+    # otherwise compile inside the 2K bracket only — inflating the
+    # differenced per-step cost instead of cancelling.
+    sparse_sharded_run(2 * n_steps)
+    s1 = min(sparse_sharded_run(n_steps)[1] for _ in range(2))
+    eng_final, t2a = sparse_sharded_run(2 * n_steps)
+    s2 = min(t2a, sparse_sharded_run(2 * n_steps)[1])
+    sparse_step = (s2 - s1) / n_steps if s2 > s1 else s1 / n_steps
+
+    # Single-device sparse leg (PR 13's engine): the other parent.
+    def single_run(n):
+        eng = ActiveTileEngine(spec, board, tile=tile)
+        t0 = time.perf_counter()
+        eng.step(n)
+        return eng, time.perf_counter() - t0
+
+    single_run(n_steps)  # warm
+    g1 = min(single_run(n_steps)[1] for _ in range(2))
+    g2 = min(single_run(2 * n_steps)[1] for _ in range(2))
+    single_step = (g2 - g1) / n_steps if g2 > g1 else g1 / n_steps
+
+    bitident = np.array_equal(eng_final.snapshot(), dense_final)
+    cells = edge * edge
+    fields.update({
+        "sparse_sharded_bitident": bitident,
+        "sparse_sharded_cups": round(cells / sparse_step, 1),
+        "sparse_sharded_dense_cups": round(cells / dense_step, 1),
+        "sparse_sharded_vs_dense": round(dense_step / sparse_step, 2),
+        "sparse_sharded_single_cups": round(cells / single_step, 1),
+        "sparse_sharded_vs_single": round(single_step / sparse_step, 2),
+        "active_frac": round(eng_final.mean_active_frac, 6),
+        "sparse_sharded_engine": eng_final.engine_stamp,
+        "sparse_sharded_counters": eng_final.counters(),
+    })
+    if not bitident:
+        fields["sparse_sharded_error"] = (
+            "sparse-sharded final board diverged from the dense "
+            "sharded schedule")
+    return fields
+
+
 def _autotune_phase(args, workload: str) -> dict:
     """The AUTOTUNE phase (``--autotune K``): install any persisted
     plans from the store first (validated + parity-gated), then either
@@ -1085,6 +1225,23 @@ def main(argv=None) -> int:
                     "device CPU mesh; MOMP_HALO_OVERLAP=0 downgrades the "
                     "sharded_halo stamp to seq:*, which the sentinel "
                     "fails as a provenance downgrade)")
+    ap.add_argument("--sparse-sharded-ab", type=int, default=0,
+                    metavar="K",
+                    help="also run the SPARSE x SHARDED A/B (life "
+                    "only): K steps of the mostly-dead --sparse-board "
+                    "seed through stencils.sparse_sharded."
+                    "SparseShardedEngine on the row mesh vs the dense "
+                    "sharded runner AND vs the single-device sparse "
+                    "engine, all legs chain-differenced, the sparse-"
+                    "sharded leg oracle-parity-gated and required "
+                    "bit-identical to the dense sharded schedule, "
+                    "reporting sparse_sharded_cups / _vs_dense / "
+                    "_vs_single / active_frac plus the exchange-skip "
+                    "counters on the JSON line (needs >= 2 devices; "
+                    "MOMP_SPARSE_SHARDED=0 downgrades the "
+                    "sparse_sharded_engine stamp to dense:sharded, "
+                    "which the sentinel fails as a provenance "
+                    "downgrade)")
     ap.add_argument("--sharded-board", type=int, default=512, metavar="N",
                     help="board edge for the sharded halo A/B (default "
                     "%(default)s; must divide across the mesh's y axis)")
@@ -1199,7 +1356,8 @@ def main(argv=None) -> int:
         for flag, val in (("--batch", args.batch), ("--serve", args.serve),
                           ("--sessions", args.sessions),
                           ("--checkpoint-dir", args.checkpoint_dir),
-                          ("--sparse-ab", args.sparse_ab)):
+                          ("--sparse-ab", args.sparse_ab),
+                          ("--sparse-sharded-ab", args.sparse_sharded_ab)):
             if val:
                 ap.error(f"{flag} is a life-workload phase; "
                          f"--workload {args.workload} runs the stencil "
@@ -1210,9 +1368,12 @@ def main(argv=None) -> int:
     if args.sharded_ab and args.sharded_ab < 16:
         ap.error("--sharded-ab needs >= 16 steps for the "
                  "chained-differencing bracket")
-    if args.sparse_ab:
-        if args.sparse_ab < 16:
+    if args.sparse_ab or args.sparse_sharded_ab:
+        if args.sparse_ab and args.sparse_ab < 16:
             ap.error("--sparse-ab needs >= 16 steps for the "
+                     "chained-differencing bracket")
+        if args.sparse_sharded_ab and args.sparse_sharded_ab < 16:
+            ap.error("--sparse-sharded-ab needs >= 16 steps for the "
                      "chained-differencing bracket")
         if args.sparse_tile < 1 or args.sparse_board % args.sparse_tile:
             ap.error(f"--sparse-board {args.sparse_board} must be a "
@@ -1553,6 +1714,21 @@ def _bench(args, state) -> int:
                               "sharded_ab_error":
                               f"{type(e).__name__}: {e}"[:200]}
 
+    # Sparse x sharded A/B (opt-in via --sparse-sharded-ab K): the
+    # composition of the sparse active-tile mask with the sharded halo
+    # exchange. Same failure contract as the other opt-in phases.
+    sparse_sharded = {}
+    if args.sparse_sharded_ab:
+        state["phase"] = "sparse_sharded"
+        with obs_trace.span("bench.phase", phase="sparse_sharded"):
+            try:
+                sparse_sharded = _sparse_sharded_ab_phase(args)
+            except Exception as e:
+                sparse_sharded = {
+                    "sparse_sharded_board": args.sparse_board,
+                    "sparse_sharded_error":
+                    f"{type(e).__name__}: {e}"[:200]}
+
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device
     # serial dispatch, this measures what a user of the sharded API gets
@@ -1840,6 +2016,7 @@ def _bench(args, state) -> int:
         **served,
         **sparse,
         **sharded_ab,
+        **sparse_sharded,
         **sharded,
         **prof_fields,
         **trace_fields,

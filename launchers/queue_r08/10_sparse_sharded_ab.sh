@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# r08 queued increment (ISSUE 16, DESIGN.md §18): the sparse x sharded
+# composition on the real chip — the composed-engine A/B against the
+# dense sharded runner and the single-device active-tile engine at the
+# acceptance geometry (2048², ~1% live), at both the throughput tile
+# (64) and the CPU-mesh winner (32), so the chip decides the tile trade
+# for itself. On a single-device topology the phase reports
+# sparse_sharded_error (needs >= 2 devices) and the line still lands;
+# on a ring it must stamp sparse-sharded:row:t<tile> provenance with
+# the final board BIT-identical to the dense sharded schedule and
+# exchange_skips > 0 (dead-boundary rounds shipping the zero sentinel
+# instead of the ppermute payload). Every line lands in MOMP_LEDGER
+# (exported by tpu_queue_loop.sh) under the sparse-keyed baseline
+# groups, so a later run whose plan silently degrades to dense:*
+# (e.g. MOMP_SPARSE_SHARDED=0 left exported) flags at the queue loop's
+# sentinel gate as a provenance downgrade. One chip process per bench
+# run, sequential; exits nonzero on failure so the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python bench.py --board 500 --steps 500 --sparse-sharded-ab 256 \
+    --sparse-board 2048 --sparse-tile 64
+
+python bench.py --board 500 --steps 500 --sparse-sharded-ab 256 \
+    --sparse-board 2048 --sparse-tile 32
+
+# Settled-session skip drill (the pool twin of the same bet): a still
+# life among active resident sessions must stop dispatching once its
+# per-lane fixed point is proven — on the chip that converts the ~70 ms
+# relay RTT per skipped step group into zero — while snapshots stay
+# oracle-exact (the skip is a proof, not an approximation).
+python - <<'PYEOF'
+import numpy as np
+
+from mpi_and_open_mp_tpu import stencils
+from mpi_and_open_mp_tpu.serve import ServePolicy, ServingDaemon
+
+spec = stencils.get("life")
+rng = np.random.default_rng(20260807)
+daemon = ServingDaemon(ServePolicy(max_batch=4, max_wait_s=0.0))
+boards = {}
+for i in range(3):
+    board = (rng.random((18, 18)) < 0.3).astype(np.uint8)
+    if i == 0:
+        board = np.zeros((18, 18), np.uint8)
+        board[8:10, 8:10] = 1  # still life: block
+    boards[f"s{i}"] = board
+    daemon.create_session(f"s{i}", board)
+for _ in range(6):
+    for sid in boards:
+        daemon.step_session(sid, 3)
+for sid, board in boards.items():
+    np.testing.assert_array_equal(
+        daemon.snapshot_session(sid), stencils.oracle_run(spec, board, 18))
+skips = daemon.summary()["pool_settled_skips"]
+assert skips > 0, "settled still-life session never skipped a dispatch"
+print(f"settled drill: {skips} dispatches skipped, all snapshots oracle-exact")
+PYEOF

@@ -60,10 +60,14 @@ ENV = "MOMP_LEDGER"
 #: in PR 15 (persistent halo plans): the sharded halo schedule stamp
 #: ({overlap:*, seq:*}) — the sentinel treats overlap -> seq as a
 #: provenance downgrade (the kill switch silently left on is exactly the
-#: regression this catches).
+#: regression this catches). ``sparse`` joined in PR 16 (sparse x
+#: sharded): the active-tile engine stamp for whichever sparse phase the
+#: line ran ({sparse-sharded:*, sparse:*, dense:*}) — the sentinel
+#: treats sparse-sharded -> dense:sharded (MOMP_SPARSE_SHARDED=0 left
+#: on) as a provenance downgrade.
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
               "batch_pack_layout", "resident", "workload", "plan",
-              "halo", "engine")
+              "halo", "sparse", "engine")
 
 _GIT_SHA: str | None = None
 
@@ -133,6 +137,11 @@ def stamp(record: dict, *, source: str = "bench.py",
         # "-" for lines without a sharded A/B; scheduled lines carry the
         # haloplan engine stamp ({overlap:*, seq:*}).
         "halo": record.get("sharded_halo", "-"),
+        # "-" for lines without a sparse phase; the sparse-sharded A/B
+        # stamp wins over the single-device one when both phases ran
+        # (it is the composed engine this key exists to pin).
+        "sparse": record.get("sparse_sharded_engine",
+                             record.get("sparse_engine", "-")),
         "engine": record.get("impl", "?"),
     }
     return {
@@ -184,7 +193,8 @@ def load(path: str) -> list[dict]:
 #: "unrecorded": entries stamped before the field joined KEY_FIELDS must
 #: keep matching new lines that carry the explicit "-" placeholder.
 _KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-",
-                 "workload": "life", "plan": "-", "halo": "-"}
+                 "workload": "life", "plan": "-", "halo": "-",
+                 "sparse": "-"}
 
 
 def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:

@@ -1327,6 +1327,18 @@ def unpack_batch_bits(planes: jnp.ndarray, b: int) -> jnp.ndarray:
     return rows[:b].astype(jnp.uint8)
 
 
+def lane_change_bits(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane change summary of two (P, ny, nx) bit-sliced slabs: one
+    uint32 per plane whose bit ``l`` is set iff lane ``l``'s board
+    differs anywhere between ``a`` and ``b`` — an OR-reduction of the
+    XOR over both spatial axes, so the whole summary costs one
+    elementwise pass and ships 4*P bytes. When ``a`` and ``b`` are
+    CONSECUTIVE steps of the same slab, a zero bit is a proven fixed
+    point (the next step of an unchanged board is unchanged forever) —
+    the predicate the session pool's settled-skip rides."""
+    return lax.reduce(a ^ b, jnp.uint32(0), lax.bitwise_or, (1, 2))
+
+
 def _carry_save_rule9(c, up, dn, lf, rt, ul, ur, dl, dr):
     """:func:`_carry_save_rule` with all eight neighbours supplied as
     operands instead of via roll callbacks — the form the halo-fused XLA

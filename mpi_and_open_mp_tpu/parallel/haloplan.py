@@ -363,3 +363,53 @@ def fused_step(plan: HaloPlan, step_fn, block: jnp.ndarray) -> jnp.ndarray:
     if plan.overlap:
         return overlap_fused_step(plan, step_fn, block)
     return sequential_fused_step(plan, step_fn, block)
+
+
+# ------------------------------------------------- padded frames for engines
+#
+# The sparse-sharded engine (stencils.sparse_sharded) gathers tiles out
+# of a padded shard frame instead of stepping the whole shard, so it
+# needs the PADDING itself, not the fused round. Exposing the exact
+# sequential-schedule frame keeps its per-cell arithmetic bit-identical
+# to the dense sharded path; the zero-sentinel twin is the exchange-skip
+# round — legal only when every shard's boundary band is dead (the
+# ghosts it replaces are then provably all-zero; DESIGN.md §18).
+
+
+def padded_round_block(layout: str, block: jnp.ndarray,
+                       depth: int) -> jnp.ndarray:
+    """One round's halo-padded shard frame, exchanged exactly as the
+    sequential schedule pads it (same concat order, same ppermutes —
+    ``halo._note_exchange`` ticks identically). Must run inside
+    ``shard_map`` with the layout's axes in scope."""
+    d = depth
+    if layout == "row":
+        return halo.halo_pad_y(jnp.concatenate(
+            [block[..., -d:], block, block[..., :d]], axis=-1), "y", d)
+    if layout == "col":
+        return halo.halo_pad_x(jnp.concatenate(
+            [block[..., -d:, :], block, block[..., :d, :]], axis=-2),
+            "x", d)
+    return halo.halo_pad_2d(block, "y", "x", d)
+
+
+def padded_round_block_local(layout: str, block: jnp.ndarray,
+                             depth: int) -> jnp.ndarray:
+    """The zero-sentinel twin of :func:`padded_round_block`: unsharded
+    axes wrap locally (they hold the full torus extent, so the local
+    wrap IS the true wrap), sharded axes pad with static zeros and no
+    collective is issued. Bit-exact iff every shard's boundary band is
+    dead — the caller's host-global skip decision, never a per-device
+    branch (the ring stays deadlock-free because each compiled program
+    is collective-complete)."""
+    d = depth
+    pad = [(0, 0)] * (block.ndim - 2)
+    if layout == "row":
+        wrapped = jnp.concatenate(
+            [block[..., -d:], block, block[..., :d]], axis=-1)
+        return jnp.pad(wrapped, pad + [(d, d), (0, 0)])
+    if layout == "col":
+        wrapped = jnp.concatenate(
+            [block[..., -d:, :], block, block[..., :d, :]], axis=-2)
+        return jnp.pad(wrapped, pad + [(0, 0), (d, d)])
+    return jnp.pad(block, pad + [(d, d), (d, d)])

@@ -983,6 +983,7 @@ class ServingDaemon:
             out["pool_evictions"] = s["evictions"]
             out["pool_spills"] = s["spills"]
             out["pool_compactions"] = s["compactions"]
+            out["pool_settled_skips"] = s["settled_skips"]
         if self._wal is not None:
             out["wal"] = self._wal.stats()
         if self._aot is not None:

@@ -29,6 +29,17 @@ program are different executables). Lanes NOT in the mask pass through
 bit-identically: slab-mates are untouched, which is what makes the
 slab a pool and not a batch.
 
+**Settled skip.** Each step dispatch also returns a per-plane settled
+word (``ops.bitlife.lane_change_bits`` over the loop's final
+consecutive-state pair — a set bit is a PROVEN fixed point, so a
+period-k oscillator never reads as settled). The word resolves lazily
+before the slab's next step; when every session in a slab group is
+settled, the dispatch is skipped outright (``pool.settled_skips``) and
+only the logical ``steps_applied`` advances — bit-identical by the
+fixed-point argument, and WAL STEP frames stay authoritative because
+replay re-proves settledness from the same boards. Any rewrite
+(create, revive) clears the flag; it is re-proven, never assumed.
+
 **Lane allocation** is a free-lane bitmap per slab (bit ``l`` set =
 lane ``l`` free). Create takes the lowest free lane of the fullest
 slab of the board's shape (dense packing keeps masks cheap and
@@ -75,7 +86,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_and_open_mp_tpu.ops.bitlife import (
-    _carry_save_rule9, _note_retrace, pack_batch_bits, unpack_batch_bits)
+    _carry_save_rule9, _note_retrace, lane_change_bits, pack_batch_bits,
+    unpack_batch_bits)
 
 #: Boards per bit-plane — the uint32 word width of the sliced layout.
 LANES_PER_PLANE = 32
@@ -124,6 +136,11 @@ class _Session:
     handle: Handle | None = None  # None = spilled to host
     host: np.ndarray | None = None  # the board, when spilled
     steps_applied: int = 0
+    #: Proven still life: the last dispatch's final step changed nothing
+    #: on this lane (consecutive-state equality, NOT same-as-start — a
+    #: period-k oscillator that returns to its start is never settled).
+    #: False on create/revive; cleared whenever the board is rewritten.
+    settled: bool = False
 
 
 # --------------------------------------------------------------- device ops
@@ -152,12 +169,22 @@ def _torus_step(planes):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _pool_step_jit(planes, steps, mask):
     """Advance the masked lanes ``steps`` Life steps IN PLACE (the slab
-    buffer is donated). Unmasked lanes pass through bit-identically."""
+    buffer is donated). Unmasked lanes pass through bit-identically.
+
+    Also returns the per-plane SETTLED word: bit ``l`` set iff lane
+    ``l`` is masked and its final step was the identity — the loop
+    carries ``(prev, cur)`` so the comparison is between consecutive
+    states, which proves a true fixed point (an oscillator whose period
+    divides ``steps`` returns to its start but fails prev == cur). The
+    word costs one XOR/OR reduction on state already in registers; the
+    pool uses it to skip future dispatches for all-settled groups."""
     _note_retrace("pool_step")
-    stepped = jax.lax.fori_loop(
-        0, steps, lambda _, p: _torus_step(p), planes)
+    prev, cur = jax.lax.fori_loop(
+        0, steps, lambda _, c: (c[1], _torus_step(c[1])),
+        (planes, planes))
+    settled = ~lane_change_bits(prev, cur) & mask
     m = mask[:, None, None]
-    return (stepped & m) | (planes & ~m)
+    return (cur & m) | (planes & ~m), settled
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -210,7 +237,13 @@ class SessionPool:
             "creates": 0, "hits": 0, "misses": 0, "evictions": 0,
             "spills": 0, "revivals": 0, "compactions": 0, "migrated": 0,
             "slabs_freed": 0, "dispatches": 0, "steps_applied": 0,
+            "settled_skips": 0,
         }
+        # Deferred settled words: slab_id -> (device word array, [(sess,
+        # lane)] at dispatch time). Resolved lazily at the NEXT step of
+        # the same slab so the fetch never forces a sync on the dispatch
+        # hot path (the dispatch itself stays fire-and-forget).
+        self._pending_settled: dict[int, tuple] = {}
 
     # -- geometry ----------------------------------------------------------
 
@@ -350,6 +383,7 @@ class SessionPool:
         slab.lanes.pop(h.lane, None)
         if not slab.lanes:
             del self._slabs[h.slab]
+            self._pending_settled.pop(h.slab, None)
             self.counts["slabs_freed"] += 1
 
     def _spill_one(self) -> bool:
@@ -370,6 +404,21 @@ class SessionPool:
             return True
         return False
 
+    def _resolve_settled(self, slab_id: int) -> None:
+        """Fetch a slab's deferred settled word (if one is pending) and
+        fan the bits out to the dispatched sessions. Called before the
+        slab's next step decision — by then the dispatch that produced
+        the word has long completed, so the fetch is not a stall."""
+        pending = self._pending_settled.pop(slab_id, None)
+        if pending is None:
+            return
+        word, lanes = pending
+        word = np.asarray(word)
+        for sess, lane in lanes:
+            sess.settled = bool(
+                (int(word[lane // LANES_PER_PLANE])
+                 >> (lane % LANES_PER_PLANE)) & 1)
+
     def _resident(self, sid: str) -> _Session:
         """The session, revived onto a lane if it was spilled. Counts
         the pool.hit/pool.miss pair — a miss is exactly one host→device
@@ -389,6 +438,7 @@ class SessionPool:
         self._write_lane(h, sess.host)
         self._slabs[h.slab].lanes[h.lane] = sid
         sess.handle, sess.host = h, None
+        sess.settled = False  # re-prove after any rewrite, never carry
         self._touch(sid)
         return sess
 
@@ -446,16 +496,32 @@ class SessionPool:
             self._pinned.difference_update(sids)
         if steps == 0:
             return 0
-        dispatches = 0
+        dispatches = skips = 0
         for slab_id, group in by_slab.items():
             slab = self._slabs[slab_id]
+            self._resolve_settled(slab_id)
+            if all(sess.settled for sess in group):
+                # Every lane in the group is a proven fixed point:
+                # advancing ANY step count is the identity, so the
+                # logical step count moves while the device does
+                # nothing. WAL STEP frames stay authoritative — replay
+                # re-proves settledness from the board and lands on the
+                # same bits whether or not the skip engages.
+                skips += 1
+                for sess in group:
+                    sess.steps_applied += steps
+                trace.event("pool.settled_skip", slab=slab_id,
+                            lanes=len(group), steps=steps)
+                continue
             mask = np.zeros(self._planes_per_slab, np.uint32)
             for sess in group:
                 lane = sess.handle.lane
                 mask[lane // LANES_PER_PLANE] |= np.uint32(
                     1 << (lane % LANES_PER_PLANE))
-            slab.planes = _pool_step_jit(
+            slab.planes, settled = _pool_step_jit(
                 slab.planes, jnp.int32(steps), jnp.asarray(mask))
+            self._pending_settled[slab_id] = (
+                settled, [(sess, sess.handle.lane) for sess in group])
             dispatches += 1
             for sess in group:
                 sess.steps_applied += steps
@@ -463,7 +529,10 @@ class SessionPool:
                         steps=steps)
         self.counts["dispatches"] += dispatches
         self.counts["steps_applied"] += steps * len(sids)
+        self.counts["settled_skips"] += skips
         metrics.inc("pool.dispatches", dispatches)
+        if skips:
+            metrics.inc("pool.settled_skips", skips)
         return dispatches
 
     def snapshot(self, sid: str) -> np.ndarray:
@@ -537,6 +606,10 @@ class SessionPool:
                         boards.append(stack[lane])
                         sids.append(sid)
                 del self._slabs[s_id]
+                # Lanes move: a deferred settled word indexed by the old
+                # lane order must not resolve against the new layout.
+                # Dropping it is conservative (settled stays False).
+                self._pending_settled.pop(s_id, None)
                 freed += 1
             # Repack 32*P-at-a-time (the pack kernel) into fresh dense
             # slabs; zero-padded tail lanes stay free.

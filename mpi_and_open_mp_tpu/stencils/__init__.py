@@ -1,8 +1,10 @@
 """Stencil spec subsystem: every rule a servable workload.
 
 See ``stencils.spec`` (the declarative :class:`StencilSpec` + registry),
-``stencils.engine`` (spec-generated roll / padded / oracle steps), and
-``stencils.sparse`` (the active-tile engine for mostly-dead boards).
+``stencils.engine`` (spec-generated roll / padded / oracle steps),
+``stencils.sparse`` (the active-tile engine for mostly-dead boards), and
+``stencils.sparse_sharded`` (the same skip logic composed with the
+sharded halo exchange — global tile mask, cross-shard activation).
 """
 
 from .engine import (  # noqa: F401
@@ -29,3 +31,4 @@ from .spec import (  # noqa: F401
     register,
 )
 from .sparse import ActiveTileEngine  # noqa: F401
+from .sparse_sharded import SparseShardedEngine  # noqa: F401

@@ -251,6 +251,11 @@ def _sharded_pspec(layout: str, channels: int):
     return P(None, *axes) if channels > 1 else P(*axes)
 
 
+#: Public alias — the sparse-sharded engine and the tuner place boards
+#: with the same spec the sharded runner uses, by name.
+sharded_pspec = _sharded_pspec
+
+
 def mesh_axes_for(layout: str, mesh) -> tuple[int, int]:
     """(py, px) shard counts per board axis under ``layout``."""
     py = mesh.shape.get("y", 1) if layout in ("row", "cart") else 1

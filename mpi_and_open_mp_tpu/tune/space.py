@@ -49,9 +49,17 @@ class Candidate:
     bucket_rounding: str
     #: Decomposition axis order (single-process profiling: "row").
     axis_order: str = "row"
-    #: Halo schedule for sharded candidates ("overlap"/"seq"); "-" for
-    #: single-device paths, where there is no exchange to schedule.
+    #: Halo schedule for sharded candidates ("overlap"/"seq", or
+    #: "sparse" for the ``sparse_sharded:*`` active-tile paths, which
+    #: decide exchange-vs-skip per round); "-" for single-device paths,
+    #: where there is no exchange to schedule.
     halo_overlap: str = "-"
+
+
+#: Tile edge the sparse-sharded candidates profile at — one fixed rung
+#: (PR 13's sweep showed tile choice is second-order next to the
+#: sparse-vs-dense decision itself, which is what the race measures).
+SPARSE_SHARDED_TILE = 64
 
 
 def axis_orders(device_count: int = 1,
@@ -81,10 +89,17 @@ def sharded_candidates(workload: str, shape: tuple[int, int],
     and the "overlap" leg only where the persistent plan accepts the
     geometry (``parallel.haloplan``; the "seq" leg is always legal, so
     the historic schedule is always in the race — the sharded twin of
-    heuristic-first)."""
+    heuristic-first). Single-channel workloads additionally list the
+    ``sparse_sharded:<layout>`` active-tile path where its plan accepts
+    the geometry (tile divides the shard, ``MOMP_SPARSE_SHARDED`` not
+    killed) — the dense legs are enumerated FIRST, so the heuristic
+    stays in the race and a sparse candidate only wins by measurement
+    (on the tuner's dense random boards it falls to the crossover rung
+    and loses, which is the honest answer)."""
     from mpi_and_open_mp_tpu.parallel import haloplan
     from mpi_and_open_mp_tpu import stencils
     from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+    from mpi_and_open_mp_tpu.stencils import sparse_sharded
 
     spec = stencils.get(workload)
     ny, nx = (int(x) for x in shape)
@@ -105,6 +120,16 @@ def sharded_candidates(workload: str, shape: tuple[int, int],
                 workload=str(workload), path=f"sharded:{layout}",
                 pack_layout="-", bucket_rounding=BUCKET_POW2,
                 axis_order=layout, halo_overlap=sched))
+        if spec.channels == 1:
+            sp = sparse_sharded.plan_sparse_sharded(
+                layout, (py, px), shard, spec.radius,
+                SPARSE_SHARDED_TILE)
+            if sp.enabled:
+                out.append(Candidate(
+                    workload=str(workload),
+                    path=f"sparse_sharded:{layout}",
+                    pack_layout="-", bucket_rounding=BUCKET_POW2,
+                    axis_order=layout, halo_overlap="sparse"))
     return out
 
 

@@ -8,7 +8,8 @@ chaos site hard-kills the process with ``os._exit(137)`` — no atexit, no
 finally — and the parent then replays the journal and asserts the
 per-fsync-policy loss bound over exactly the acked set.
 
-Usage: ``python _wal_crash_driver.py WAL_PATH FSYNC_POLICY ACK_PATH N [pool]``
+Usage: ``python _wal_crash_driver.py WAL_PATH FSYNC_POLICY ACK_PATH N
+[pool|settled]``
 
 With the optional ``pool`` mode the driver exercises the resident-
 session handle lifecycle instead of the ticket path: create N pool
@@ -20,6 +21,15 @@ AFTER the frame is journaled and BEFORE the pool acts, so an acked op is
 always durable under ``every-record`` and the parent can assert the
 resumed pool matches the acked ledger exactly (plus at most one
 journaled-but-unacked op — the at-least-once edge).
+
+The ``settled`` mode is the pool mode with session p0 seeded as a STILL
+LIFE (a block) among active random boards, and enough 2-step rounds for
+the settled-skip fast path to engage (p0's dispatches stop once its
+fixed point is proven). The WAL's STEP frames stay authoritative:
+replay re-applies every journaled step and RE-PROVES settledness, so
+the parent asserts the resumed p0 snapshot is bit-identical to the
+oracle at the acked step count even though some of those steps were
+never dispatched by the pre-kill process.
 
 Exits 0 after a clean drain (printing a one-line JSON summary); a
 planned crash never reaches that code.
@@ -46,7 +56,8 @@ def main() -> int:
 
     wal_path, fsync, ack_path = sys.argv[1], sys.argv[2], sys.argv[3]
     n = int(sys.argv[4])
-    pool_mode = len(sys.argv) > 5 and sys.argv[5] == "pool"
+    mode = sys.argv[5] if len(sys.argv) > 5 else ""
+    pool_mode = mode in ("pool", "settled")
     policy = ServePolicy(max_batch=4, max_wait_s=0.0)
     daemon = ServingDaemon(policy, wal_path=wal_path, wal_fsync=fsync)
     rng = np.random.default_rng(7)
@@ -59,9 +70,17 @@ def main() -> int:
         if pool_mode:
             for i in range(n):
                 board = (rng.random((12, 12)) < 0.3).astype(np.uint8)
+                if mode == "settled" and i == 0:
+                    # p0 is a still life: its dispatches stop once the
+                    # pool proves the per-lane fixed point.
+                    board = np.zeros((12, 12), np.uint8)
+                    board[5:7, 5:7] = 1
                 daemon.create_session(f"p{i}", board)
                 rec(f"C p{i}")
-            for _ in range(2):
+            # settled mode runs extra rounds: the first round proves
+            # p0's fixed point, later rounds exercise the skip path
+            # with the chaos site still armed.
+            for _ in range(5 if mode == "settled" else 2):
                 for i in range(n):
                     daemon.step_session(f"p{i}", 2)
                     rec(f"S p{i} 2")

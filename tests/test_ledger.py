@@ -62,7 +62,7 @@ def test_stamp_schema_and_config_key():
         "metric": "life_steady_cups_p46gun_big", "topology": "tpu:1",
         "shape": "500x500", "dtype": "uint8", "steps": 10_000,
         "batch": 0, "batch_pack_layout": "-", "resident": "-",
-        "workload": "life", "plan": "-", "halo": "-",
+        "workload": "life", "plan": "-", "halo": "-", "sparse": "-",
         "engine": "pallas",
     }
     # Full key renders in canonical order; any subset stays stable.

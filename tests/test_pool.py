@@ -401,6 +401,109 @@ def test_batcher_coalesces_small_session_groups(rng):
         ShapeBucketBatcher().submit_session("s0", 1)
 
 
+# ---------------------------------------------------------- settled skip
+
+
+def _still_life(n):
+    b = np.zeros((n, n), np.uint8)
+    b[n // 2:n // 2 + 2, n // 2:n // 2 + 2] = 1  # block
+    return b
+
+
+def _blinker(n):
+    b = np.zeros((n, n), np.uint8)
+    b[n // 2, n // 2 - 1:n // 2 + 2] = 1
+    return b
+
+
+def test_settled_group_skips_dispatch():
+    """PR 16 satellite: once every session in a slab group is a proven
+    fixed point, STEP dispatches stop. steps_applied still advances
+    (the WAL contract: journaled steps are authoritative), snapshots
+    stay bit-exact, and the skip is counted."""
+    pool = SessionPool()
+    boards = {f"q{i}": _still_life(18) for i in range(3)}
+    for sid, b in boards.items():
+        pool.create(sid, b)
+    sids = list(boards)
+    assert pool.step_group(sids, 2) == 1      # dispatch proves the point
+    assert pool.counts["settled_skips"] == 0  # word resolves lazily
+    # The next group step resolves the deferred word FIRST, sees every
+    # lane settled, and skips without ever dispatching again.
+    assert pool.step_group(sids, 2) == 0
+    assert pool.step_group(sids, 2) == 0
+    assert pool.counts["settled_skips"] == 2
+    assert pool.counts["steps_applied"] == 18  # 3 sessions x 6 steps
+    for sid, b in boards.items():
+        np.testing.assert_array_equal(pool.snapshot(sid), b)
+
+
+def test_oscillator_never_reads_as_settled():
+    """The (prev, cur) carry in the step program: a period-2 blinker
+    stepped an EVEN number of steps returns to its start — an
+    initial-vs-final diff would call it settled; the consecutive-state
+    proof must not."""
+    pool = SessionPool()
+    pool.create("osc", _blinker(18))
+    for _ in range(4):
+        assert pool.step_group(["osc"], 2) == 1  # never skipped
+    assert pool.counts["settled_skips"] == 0
+    np.testing.assert_array_equal(
+        pool.snapshot("osc"), oracle_n(_blinker(18), 8))
+
+
+def test_mixed_slab_group_never_skips():
+    """One live session in the group holds the whole dispatch: the
+    settled block rides along (lane-masked) and stays bit-exact."""
+    pool = SessionPool()
+    pool.create("still", _still_life(20))
+    pool.create("osc", _blinker(20))
+    sids = ["still", "osc"]
+    for _ in range(3):
+        assert pool.step_group(sids, 2) == 1
+    assert pool.counts["settled_skips"] == 0
+    np.testing.assert_array_equal(pool.snapshot("still"), _still_life(20))
+    np.testing.assert_array_equal(
+        pool.snapshot("osc"), oracle_n(_blinker(20), 6))
+
+
+def test_settled_session_crash_resume_parity(tmp_path):
+    """kill -9 with the skip engaged: the driver's still-life p0 stops
+    dispatching after its fixed point is proven, then the process dies
+    at a post-step chaos site. The WAL's STEP frames are authoritative:
+    replay + resume must re-prove settledness and land p0 (and every
+    survivor) bit-identical to the oracle at the acked step count —
+    steps that were never dispatched pre-kill included."""
+    walp = str(tmp_path / "settled.wal")
+    ackp = str(tmp_path / "acked.ops")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MOMP_CHAOS="crash=post-step:15")
+    proc = subprocess.run(
+        [sys.executable, DRIVER, walp, "every-record", ackp, "4",
+         "settled"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == chaos.CRASH_EXIT == 137, (
+        f"crash never fired: rc={proc.returncode} "
+        f"out={proc.stdout!r} err={proc.stderr!r}")
+    acked_steps: dict[str, int] = {}
+    for ln in open(ackp).read().splitlines():
+        op = ln.split()
+        if op and op[0] == "S":
+            acked_steps[op[1]] = acked_steps.get(op[1], 0) + int(op[2])
+    assert acked_steps.get("p0", 0) >= 6, "skip never got to engage"
+
+    rep = wal.replay(walp)
+    d, source, _ = ServingDaemon.resume_any(
+        wal_path=walp, policy=ServePolicy(max_batch=4, max_wait_s=0.0))
+    assert source == "wal"
+    for sid, entry in rep.pool_sessions.items():
+        np.testing.assert_array_equal(
+            d.snapshot_session(sid),
+            oracle_n(np.asarray(entry["board"]), int(entry["steps"])))
+    # The resumed daemon surfaces the skip counter (summary plumbing).
+    assert "pool_settled_skips" in d.summary()
+
+
 # ------------------------------------------------- sentinel/ledger plumbing
 
 
