@@ -140,6 +140,17 @@ WATCH_FIELDS = (
     "sparse_sharded_cups",
     "sparse_sharded_vs_dense",
     "sparse_sharded_vs_single",
+    # Elastic fleet under open-loop load (PR 17): steady-state goodput
+    # at the saturation sweep's knee rung (higher by default — "rps"
+    # deliberately avoids the _s suffix), the extreme-tail latency at
+    # that rung (lower by the latency rule), and the wedge→REJOIN→
+    # recovered time from the membership drill (lower by the _s rule) —
+    # recovery regressing means the resume-from-WAL + ring re-entry +
+    # claim ladder got slower. The per-rung curve rides the JSON line
+    # as context; the knee scalars are what the sentinel judges.
+    "loadgen_goodput_rps",
+    "loadgen_p999_latency_s",
+    "rejoin_recovery_s",
 )
 
 

@@ -502,6 +502,195 @@ def _fleet_phase(n: int, workers: int) -> dict:
     return fields
 
 
+def _loadgen_phase(args) -> dict:
+    """The elastic-fleet-under-load phase (``--loadgen R1,R2,..``).
+
+    Two drills. (1) **Saturation sweep**: an open-loop Poisson arrival
+    schedule (``serve.loadgen`` — arrivals are precomputed, never a
+    reaction to completions, so there is no coordinated omission) over
+    a mixed scenario (one-shot batch boards, resident-session steps,
+    snapshot reads) at each offered rate on a FRESH fleet, judged
+    against the declared SLO; ``loadgen_knee_rps`` is the last rung
+    that met it — the capacity number — and the whole curve rides the
+    line as ``loadgen_curve``. (2) **Membership cycle**: one run at the
+    knee rate with the production failure script as scheduled events —
+    wedge the busiest worker at 25% of the run, REJOIN it at 45%
+    (``rejoin_recovery_s`` prices the resume-from-WAL + bounded ring
+    re-entry + claim ladder), gracefully drain another at 65% — and
+    the final-quartile goodput must recover to the pre-fault rate
+    (``loadgen_cycle_recovery_frac``) with zero acked loss and the
+    books balanced across both membership changes. Honesty discipline
+    as everywhere: every resolved board gates bit-exact against the
+    NumPy oracle, and every resident session's final snapshot gates
+    against the oracle at its journaled step total, before anything is
+    recorded."""
+    import tempfile
+
+    from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
+    from mpi_and_open_mp_tpu.serve import (
+        SLO, ScenarioMix, ServePolicy, run_open_loop, saturation_knee)
+    from mpi_and_open_mp_tpu.serve.fleet import Fleet
+
+    rates = [float(r) for r in str(args.loadgen).split(",") if r.strip()]
+    workers = args.fleet or 3
+    duration = args.loadgen_duration
+    slo = SLO(p99_s=args.loadgen_slo_p99, goodput_frac=0.5)
+    mix = ScenarioMix(batch=0.7, resident=0.25, snapshot=0.05,
+                      shapes=((48, 48), (64, 64)), steps=(2, 4),
+                      sessions=max(8, 2 * workers))
+    policy = ServePolicy(max_batch=8, max_depth=256, max_wait_s=0.005)
+
+    def parity_bad(fleet) -> int:
+        bad = 0
+        for t in fleet.resolved_tickets():
+            if t.board is None:
+                continue  # resident step — gated via the snapshot below
+            ref = np.asarray(t.board).copy()
+            for _ in range(t.steps):
+                ref = life_step_numpy(ref)
+            if not np.array_equal(t.result, ref):
+                bad += 1
+        for sid in list(fleet.router._session_home):
+            home = fleet.router._home_worker(sid)
+            entry = home.daemon._session_log.get(sid)
+            if entry is None:
+                bad += 1
+                continue
+            ref = np.asarray(entry["board"]).copy()
+            for _ in range(int(entry["steps"])):
+                ref = life_step_numpy(ref)
+            if not np.array_equal(fleet.snapshot_session(sid), ref):
+                bad += 1
+        return bad
+
+    fields: dict = {
+        "loadgen_workers": workers,
+        "loadgen_rates": rates,
+        "loadgen_duration_s": duration,
+        "loadgen_slo_p99_s": slo.p99_s,
+        "loadgen_slo_goodput_frac": slo.goodput_frac,
+    }
+    with tempfile.TemporaryDirectory(prefix="momp-bench-loadgen-") as td:
+        # -- (1) the saturation sweep: fresh fleet per rung ------------
+        reports = []
+        bad = 0
+        balanced = True
+        for j, rate in enumerate(rates):
+            fleet = Fleet(workers, policy,
+                          wal_dir=os.path.join(td, f"rung{j}"),
+                          heartbeat_interval_s=0.01)
+            rep = run_open_loop(fleet, rate, duration, mix=mix, slo=slo,
+                                seed=17)
+            reports.append(rep)
+            bad += parity_bad(fleet)
+            balanced = balanced and rep.books["balanced"]
+        knee = saturation_knee(reports)
+        at_knee = next((r for r in reversed(reports) if r.slo_ok),
+                       reports[0])
+        fields.update({
+            "loadgen_knee_rps": knee["knee_rps"],
+            "loadgen_breach_rps": knee["breach_rps"],
+            "loadgen_curve": knee["points"],
+            "loadgen_goodput_rps": round(at_knee.goodput_rps, 3),
+            "loadgen_p50_latency_s": round(at_knee.p50_s, 6),
+            "loadgen_p99_latency_s": round(at_knee.p99_s, 6),
+            "loadgen_p999_latency_s": round(at_knee.p999_s, 6),
+            "loadgen_shed": dict(at_knee.shed),
+            "loadgen_slo_ok": bool(at_knee.slo_ok),
+            "loadgen_books_balance": balanced,
+            "loadgen_parity": bad == 0,
+        })
+        if bad:
+            fields["loadgen_error"] = (
+                f"parity check failed on {bad} resolved boards/sessions "
+                "(saturation sweep)")
+
+        # -- (2) the membership cycle at the knee rate -----------------
+        cycle_rate = knee["knee_rps"] or rates[0]
+        cfleet = Fleet(workers, policy, wal_dir=os.path.join(td, "cycle"),
+                       heartbeat_interval_s=0.01)
+        drill: dict = {}
+
+        def ev_wedge(fl):
+            h = max((w for w in fl.handles
+                     if not (w.wedged or w.drained)),
+                    key=lambda w: w.daemon.queue.depth())
+            drill["victim"] = h.index
+            fl.wedge(h.index)
+
+        def ev_rejoin(fl):
+            idx = drill["victim"]
+            deadline = time.monotonic() + 10.0
+            while idx not in fl.router.wedged_workers:
+                fl.pump()
+                time.sleep(fl.router.heartbeat_interval_s)
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"cycle victim {idx} never declared wedged")
+            t0 = time.perf_counter()
+            drill["claimed"] = fl.rejoin_worker(idx)
+            drill["rejoin_s"] = time.perf_counter() - t0
+
+        def ev_drain(fl):
+            live = [w for w in fl.handles
+                    if not (w.wedged or w.drained or w.halted)
+                    and w.index != drill["victim"]]
+            h = max(live, key=lambda w: w.daemon.queue.depth())
+            drill["drained"] = h.index
+            fl.drain_worker(h.index)
+
+        crep = run_open_loop(
+            cfleet, cycle_rate, duration, mix=mix, slo=slo, seed=23,
+            events=[(0.25, ev_wedge), (0.45, ev_rejoin),
+                    (0.65, ev_drain)])
+        cbad = parity_bad(cfleet)
+        cs = cfleet.summary()
+        # Goodput recovery: resolved-per-second in the pre-fault first
+        # quartile vs the post-drain final quartile of the offered
+        # window (plus the drain tail for the last requests' results).
+        # Anchored on the first submission stamp — the run's own clock
+        # zero, after the up-front session creates' compile time.
+        done = [t for t in cfleet.resolved_tickets()
+                if t.resolved_at is not None]
+        t0 = min((t.submitted_at for t in done), default=0.0)
+        t_end = max((t.resolved_at for t in done), default=t0)
+        pre = [t for t in done if t.resolved_at - t0 < 0.25 * duration]
+        post = [t for t in done
+                if t.resolved_at - t0 >= 0.75 * duration]
+        pre_rate = len(pre) / (0.25 * duration)
+        post_win = max(t_end - t0 - 0.75 * duration, 1e-9)
+        post_rate = len(post) / post_win
+        recovery = post_rate / pre_rate if pre_rate > 0 else None
+        zero_loss = (cs["balanced"] and cs["pending"] == 0
+                     and cs["in_transit"] == 0)
+        fields.update({
+            "loadgen_cycle_rate_rps": round(cycle_rate, 3),
+            "loadgen_cycle_victim": drill.get("victim"),
+            "loadgen_cycle_claimed": drill.get("claimed"),
+            "loadgen_cycle_drained": drill.get("drained"),
+            "rejoin_recovery_s": (round(drill["rejoin_s"], 4)
+                                  if "rejoin_s" in drill else None),
+            "loadgen_cycle_goodput_rps": round(crep.goodput_rps, 3),
+            "loadgen_cycle_recovery_frac": (round(recovery, 3)
+                                            if recovery is not None
+                                            else None),
+            "loadgen_cycle_rejoins": cs["rejoins"],
+            "loadgen_cycle_drains": cs["drains"],
+            "loadgen_cycle_zero_acked_loss": zero_loss,
+            "loadgen_cycle_books_balance": cs["balanced"],
+            "loadgen_cycle_parity": cbad == 0,
+            "loadgen_cycle_ok": (
+                zero_loss and cbad == 0
+                and cs["rejoins"] == 1 and cs["drains"] == 1
+                and recovery is not None and recovery >= 0.9),
+        })
+        if cbad:
+            fields["loadgen_cycle_error"] = (
+                f"parity check failed on {cbad} resolved "
+                "boards/sessions (membership cycle)")
+    return fields
+
+
 def _sessions_phase(s: int) -> dict:
     """The resident-session phase (``--sessions S``): the device-resident
     A/B that prices what the session pool exists for. Side A (resident):
@@ -1292,6 +1481,28 @@ def main(argv=None) -> int:
                     "heartbeat->WAL-replay->re-home ladder is priced "
                     "(fleet_kill_recovery_s); fleet books must balance "
                     "and every re-homed board is oracle-parity-gated")
+    ap.add_argument("--loadgen", default=None, metavar="R1,R2,..",
+                    help="also run the ELASTIC-FLEET-UNDER-LOAD phase: "
+                    "an open-loop Poisson saturation sweep over these "
+                    "strictly increasing offered rates (requests/s) "
+                    "through a fresh consistent-hash fleet per rung "
+                    "(serve.loadgen — arrivals are a precomputed "
+                    "schedule, no coordinated omission), reporting the "
+                    "saturation knee + goodput + p50/p99/p999 + shed "
+                    "breakdown + SLO verdict per rung on the JSON line, "
+                    "then one run at the knee rate with the membership "
+                    "drill scripted in (wedge busiest at 25%%, REJOIN at "
+                    "45%% — rejoin_recovery_s — graceful drain at 65%%): "
+                    "final-quartile goodput must recover with zero acked "
+                    "loss, balanced books, and oracle parity")
+    ap.add_argument("--loadgen-duration", type=float, default=2.0,
+                    metavar="S", help="offered-load window per sweep "
+                    "rung and for the membership cycle "
+                    "(default %(default)s)")
+    ap.add_argument("--loadgen-slo-p99", type=float, default=0.5,
+                    metavar="S", help="declared p99 latency SLO bound "
+                    "the sweep rungs are judged against "
+                    "(default %(default)s)")
     ap.add_argument("--sessions", type=int, default=0, metavar="S",
                     help="also run the RESIDENT-SESSION phase: S "
                     "device-resident sessions in the serving daemon's "
@@ -1346,6 +1557,16 @@ def main(argv=None) -> int:
         ap.error("--resume requires --checkpoint-dir")
     if args.fleet and not args.serve:
         ap.error("--fleet requires --serve N")
+    if args.loadgen:
+        try:
+            rates = [float(r) for r in str(args.loadgen).split(",")
+                     if r.strip()]
+        except ValueError:
+            ap.error(f"--loadgen wants a comma list of offered rates, "
+                     f"got {args.loadgen!r}")
+        if not rates or any(b <= a for a, b in zip(rates, rates[1:])):
+            ap.error(f"--loadgen rates must be strictly increasing, "
+                     f"got {args.loadgen!r}")
     if args.workload != "life":
         from mpi_and_open_mp_tpu import stencils as _stencils
 
@@ -1355,6 +1576,7 @@ def main(argv=None) -> int:
             ap.error(str(e))
         for flag, val in (("--batch", args.batch), ("--serve", args.serve),
                           ("--sessions", args.sessions),
+                          ("--loadgen", args.loadgen),
                           ("--checkpoint-dir", args.checkpoint_dir),
                           ("--sparse-ab", args.sparse_ab),
                           ("--sparse-sharded-ab", args.sparse_sharded_ab)):
@@ -1668,6 +1890,23 @@ def _bench(args, state) -> int:
                     served.update({"fleet_workers": args.fleet,
                                    "fleet_error":
                                    f"{type(e).__name__}: {e}"[:200]})
+
+    # Elastic-fleet-under-load phase (opt-in via --loadgen R1,R2,..):
+    # open-loop saturation sweep + the wedge->REJOIN->drain membership
+    # cycle. Same failure contract as the other serve-layer phases.
+    if args.loadgen:
+        from mpi_and_open_mp_tpu.robust.preempt import Preempted
+
+        state["phase"] = "loadgen"
+        with obs_trace.span("bench.phase", phase="loadgen"):
+            try:
+                served.update(_loadgen_phase(args))
+            except Preempted:
+                raise
+            except Exception as e:
+                served.update({"loadgen_rates": args.loadgen,
+                               "loadgen_error":
+                               f"{type(e).__name__}: {e}"[:200]})
 
     # Resident-session phase (opt-in via --sessions S): the device-
     # resident vs ship-every-call A/B through the session pool. Same

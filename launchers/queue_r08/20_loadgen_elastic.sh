@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# r08 queued increment (ISSUE 17, DESIGN.md §19): the elastic fleet
+# under open-loop load on the real chip. The CPU-mesh curve knees at
+# hand-sized rates because every dispatch is a host-side XLA-CPU step;
+# on the chip the interesting question inverts — the ~70 ms relay RTT
+# per host round trip dominates small batches, so the saturation knee
+# measures how well the bucket batcher amortises the tunnel, and
+# rejoin_recovery_s prices a REAL recompile warm-up behind the
+# warming-heartbeat cover (CPU warms in milliseconds; the chip's
+# 20-40 s remote Mosaic compile is the case the cover exists for).
+# Two rungs: a modest ladder to find the knee, then the membership
+# cycle rides at it automatically (wedge busiest at 25%, REJOIN at
+# 45%, drain at 65%) — the line must land loadgen_cycle_ok with
+# parity, balanced books, zero acked loss, recovery >= 0.9. Durations
+# are generous: open-loop arrivals keep coming during compile stalls,
+# which is exactly the honesty the generator exists to enforce. One
+# chip process per bench run, sequential; exits nonzero on failure so
+# the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python bench.py --board 256 --steps 100 \
+    --loadgen 2,4,8,16 --loadgen-duration 20 --loadgen-slo-p99 2.0
+
+python bench.py --board 256 --steps 100 \
+    --loadgen 8,16,32 --loadgen-duration 30 --loadgen-slo-p99 1.0

@@ -53,7 +53,17 @@ Tokens:
     pool action runs: ``post-create``, ``post-step``, ``post-snapshot``,
     ``post-evict`` — the pool crash matrix proves resume re-materializes
     exactly the journaled state (a journaled-but-unapplied step is
-    applied on resume; nothing acked is ever lost).
+    applied on resume; nothing acked is ever lost). The fleet membership
+    protocol adds two more: ``post-rejoin`` (a rejoining/destination
+    worker journaled a claimed session's CREATE+STEP handshake frames,
+    the source's EVICT frame NOT yet written — a kill here leaves the
+    session journaled at BOTH workers with identical resumable state,
+    the at-most-duplicated, never-lost edge) and ``mid-drain`` (a
+    drained worker's bucket was adopted — journaled — at its
+    destination, the source's ``re-homed`` SHED frame NOT yet written —
+    same duplication-not-loss edge for tickets). The membership crash
+    matrix drives both across a kill -9 and asserts the books still
+    balance over exactly the acked set.
 ``kill_worker=<i>:<k>``
     Fleet drill: hard-kill (``os._exit(137)``) the serving worker whose
     ``worker_index`` is ``<i>`` on its ``<k>``-th batch dispatch, after
@@ -98,7 +108,8 @@ _HALO_KINDS = ("corrupt", "drop")
 
 #: Instrumented hard-kill sites for the ``crash=<site>:<k>`` token.
 CRASH_SITES = ("post-admit", "mid-frame", "post-dispatch",
-               "post-create", "post-step", "post-snapshot", "post-evict")
+               "post-create", "post-step", "post-snapshot", "post-evict",
+               "post-rejoin", "mid-drain")
 
 #: The exit status a hard kill reports — 128+SIGKILL, so a requeue loop
 #: or CI harness cannot tell an injected crash from a real ``kill -9``.

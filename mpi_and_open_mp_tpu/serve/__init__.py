@@ -39,12 +39,16 @@ from mpi_and_open_mp_tpu.serve.batcher import (  # noqa: F401
     retrace_counts,
 )
 from mpi_and_open_mp_tpu.serve.policy import (  # noqa: F401
+    SCALE_ADD,
+    SCALE_DRAIN,
     SHED_DEPTH,
     SHED_DISPATCH,
     SHED_PADDING,
     SHED_REASONS,
     SHED_REHOMED,
     SHED_TIMEOUT,
+    ElasticController,
+    ElasticityPolicy,
     ServePolicy,
     rollup,
 )
@@ -70,3 +74,13 @@ from mpi_and_open_mp_tpu.serve.router import (  # noqa: F401
     FleetRouter,
 )
 from mpi_and_open_mp_tpu.serve.fleet import Fleet, WorkerHandle  # noqa: F401
+from mpi_and_open_mp_tpu.serve.loadgen import (  # noqa: F401
+    SLO,
+    LoadgenReport,
+    ScenarioMix,
+    arrivals_poisson,
+    arrivals_trace,
+    run_open_loop,
+    saturation_knee,
+    sweep,
+)

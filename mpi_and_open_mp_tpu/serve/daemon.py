@@ -287,6 +287,14 @@ class ServingDaemon:
             self._wal.pool_create(session, board, wall=wall)
             if steps:
                 self._wal.pool_step(session, steps)
+            # Instrumented crash site: the destination half of a
+            # membership handshake (rejoin adoption / drain migration)
+            # is journaled, the SOURCE's EVICT frame is not — a kill
+            # here leaves the session live in BOTH journals with
+            # identical (create board, step total) resumable state:
+            # duplicated, never lost, and bit-exact either way.
+            if chaos.crash_armed("post-rejoin"):
+                chaos.crash_now()
         handle = self.pool.create(session, board)
         if steps:
             self.pool.step(session, steps)
@@ -333,16 +341,30 @@ class ServingDaemon:
         now = self._clock() if now is None else now
         live = [t for t in tickets
                 if t.state == PENDING and t.board is not None]
+        entries = self.export(live, now)
+        self._shed_batch(live, policy_mod.SHED_REHOMED, now)
+        return entries
+
+    def export(self, tickets: list[Ticket],
+               now: float | None = None) -> list[dict]:
+        """Portable entries for a group of PENDING tickets WITHOUT
+        closing this worker's books — the read half of :meth:`release`.
+        A graceful drain adopts these at the destination FIRST and only
+        then sheds them here: a crash between the halves leaves the
+        bucket journaled at both workers (duplicated, re-dispatch is
+        pure) instead of journaled at neither (lost). The wedge/steal
+        path keeps the release-first order — there the source is
+        already presumed dead and its journal replay is the source of
+        truth."""
+        now = self._clock() if now is None else now
         wall = time.time()
-        entries = [
+        return [
             {"board": np.asarray(t.board), "steps": t.steps,
              "session": t.session, "wall": wall,
              "workload": t.workload,
              "queued_s": t.queued_before_s + (now - t.submitted_at)}
-            for t in live
+            for t in tickets if t.state == PENDING and t.board is not None
         ]
-        self._shed_batch(live, policy_mod.SHED_REHOMED, now)
-        return entries
 
     def adopt(self, entries: list[dict],
               now: float | None = None) -> list[Ticket]:
@@ -811,11 +833,25 @@ class ServingDaemon:
         already promised durable, so it must happen exactly once)."""
         from mpi_and_open_mp_tpu.obs import metrics, trace
 
-        sids = [t.session for t in chunk]
         steps = chunk[0].steps
+        # Open-loop traffic can park TWO steps for the same session in
+        # one bucket. `step_group` ORs each lane into the dispatch mask,
+        # so duplicates collapse: the lane would advance `steps` once
+        # while both tickets resolve. Split the chunk into waves of
+        # distinct sessions and dispatch the waves in arrival order —
+        # the all-distinct common case stays one dispatch.
+        waves: list[list[Ticket]] = []
+        for t in chunk:
+            for wave in waves:
+                if all(w.session != t.session for w in wave):
+                    wave.append(t)
+                    break
+            else:
+                waves.append([t])
         with trace.span("serve.dispatch.pool", requests=len(chunk),
                         steps=steps):
-            self.pool.step_group(sids, steps)
+            for wave in waves:
+                self.pool.step_group([t.session for t in wave], steps)
         now = self._clock()
         for t in chunk:
             self.queue.resolve(t, None, "pool:bitsliced", now)

@@ -57,7 +57,16 @@ class WorkerHandle:
     """One worker as the router sees it: identity, daemon, journal
     path, and liveness. ``halted`` is the in-process wedge simulation
     (the fleet loop stops pumping it, so its heartbeat goes stale);
-    ``wedged`` is the router's verdict and is never cleared."""
+    ``wedged`` is the router's verdict and is never cleared.
+
+    The membership flags: ``warming`` marks a worker deserializing its
+    AOT cache after a spawn or REJOIN — alive but not yet pumping, so
+    the fleet loop stamps its beat in the shared post-round beat (the
+    same cover the slow-pump fix gives a compiling worker) until its
+    first completed pump clears the flag. ``cordoned`` means the router
+    took it off the ring mid-drain; ``drained`` is the graceful-exit
+    terminal state (like ``wedged``, never cleared — a returning worker
+    REJOINS under a fresh handle)."""
 
     index: int
     daemon: ServingDaemon
@@ -65,6 +74,9 @@ class WorkerHandle:
     last_beat: float = 0.0
     wedged: bool = False
     halted: bool = False
+    warming: bool = False
+    cordoned: bool = False
+    drained: bool = False
 
 
 class Fleet:
@@ -85,6 +97,8 @@ class Fleet:
                  heartbeat_interval_s: float = 0.02,
                  heartbeat_miss_k: int = DEFAULT_MISS_K,
                  steal: bool = True,
+                 elasticity: policy_mod.ElasticityPolicy | None = None,
+                 elastic_window_s: float = 1.0,
                  vnodes: int = DEFAULT_VNODES, seed: int = 0,
                  clock=time.monotonic, sleep=time.sleep):
         if n_workers < 1:
@@ -97,6 +111,17 @@ class Fleet:
         self._clock = clock
         self._sleep = sleep
         self._steal_enabled = steal
+        self._wal_dir = wal_dir
+        self._wal_fsync = wal_fsync
+        self._spawn_policy = policies[-1]
+        #: SLO-driven scaling: None = fixed fleet (the default — scaling
+        #: is an OPERATOR policy, opted into per deployment). With a
+        #: policy, every pump round feeds the hysteresis controller a
+        #: rolling-window p99 + fleet depth; ``add`` spawns a warming
+        #: worker, ``drain`` gracefully retires the shallowest one.
+        self.controller = (policy_mod.ElasticController(elasticity)
+                           if elasticity is not None else None)
+        self._elastic_window_s = float(elastic_window_s)
         self.handles: list[WorkerHandle] = []
         for i in range(n_workers):
             wal_path = (os.path.join(wal_dir, f"worker{i}.wal")
@@ -135,17 +160,110 @@ class Fleet:
         """Simulate a wedged worker: stop pumping it. Its heartbeat
         goes stale and the ROUTER must notice (``check_health``) —
         nothing here shortcuts the detection ladder."""
-        self.handles[index].halted = True
+        for h in self.handles:
+            if h.index == index:
+                h.halted = True
+                return
+        raise ValueError(f"no worker with index {index}")
+
+    # -- elastic membership --------------------------------------------------
+
+    def _handle_at(self, index: int) -> WorkerHandle:
+        for h in self.handles:
+            if h.index == index:
+                return h
+        raise ValueError(f"no worker with index {index}")
+
+    def rejoin_worker(self, index: int) -> int:
+        """Bring a wedged (or drained) worker back: resume a FRESH
+        daemon from the victim's own journal — the WAL handshake; a
+        completed wedge re-home left it holding only the work the fleet
+        never reassigned, so the rejoiner adopts exactly its claimed
+        sessions and nothing else — then re-enter the ring under the
+        old index (bounded movement: the old points come back, nothing
+        else shifts) and claim back the whole slab groups that hash to
+        it. The handle rejoins WARMING: the shared post-round beat
+        covers it while the AOT cache deserializes, so the wedge
+        horizon cannot re-declare it mid-warmup. Returns the number of
+        sessions claimed."""
+        old = self._handle_at(index)
+        if not (old.wedged or old.drained):
+            raise ValueError(
+                f"worker {index} is live; rejoin re-admits a wedged or "
+                "drained worker")
+        d, _source, detail = ServingDaemon.resume_any(
+            wal_path=old.wal_path, policy=old.daemon.policy,
+            wal_fsync=self._wal_fsync, worker_index=index,
+            clock=self._clock, sleep=self._sleep)
+        fresh = WorkerHandle(index=index, daemon=d,
+                             wal_path=old.wal_path,
+                             last_beat=self._clock(), warming=True)
+        claimed = self.router.rejoin_worker(fresh, self._clock())
+        # The old handle leaves the pump loop but stays on the router's
+        # retired list: its queue's history keeps counting in the books.
+        self.handles[self.handles.index(old)] = fresh
+        return claimed
+
+    def drain_worker(self, index: int) -> dict:
+        """Gracefully retire a live worker: cordon, migrate whole
+        buckets and whole slab groups to the survivors, compact + sync
+        its journal as the handoff receipt. Zero acked loss by
+        construction — every pending entry adopts at its destination
+        before the source sheds it."""
+        return self.router.drain_worker(index, self._clock())
+
+    def spawn_worker(self) -> WorkerHandle:
+        """Add a brand-new worker under the next free index (the
+        elasticity ``add`` verb). It joins WARMING — the post-round beat
+        covers its AOT deserialization — and the ring/rollup widen via
+        :meth:`FleetRouter.add_worker`."""
+        index = max(h.index for h in self.handles) + 1
+        wal_path = (os.path.join(self._wal_dir, f"worker{index}.wal")
+                    if self._wal_dir else None)
+        d = ServingDaemon(self._spawn_policy, wal_path=wal_path,
+                          wal_fsync=self._wal_fsync, worker_index=index,
+                          clock=self._clock, sleep=self._sleep)
+        h = WorkerHandle(index=index, daemon=d, wal_path=wal_path,
+                         last_beat=self._clock(), warming=True)
+        self.router.add_worker(h)
+        self.handles.append(h)
+        return h
+
+    def _autoscale(self, now: float) -> None:
+        """One elasticity tick: rolling-window p99 + fleet depth into
+        the hysteresis controller; act on its verdict. The controller
+        owns the flap protection (breach/surplus streaks + cooldown);
+        the fleet owns the verbs."""
+        window = self._elastic_window_s
+        lat = [t.latency_s for t in self.resolved_tickets()
+               if t.resolved_at is not None
+               and now - t.resolved_at <= window]
+        p99 = percentile(lat, 99) if lat else 0.0
+        live = self.router.live_workers()
+        verdict = self.controller.observe(
+            p99_s=p99, depth=self.pending(), workers=len(live))
+        if verdict == policy_mod.SCALE_ADD:
+            self.spawn_worker()
+        elif verdict == policy_mod.SCALE_DRAIN and len(live) > 1:
+            # The shallowest live worker has the least to migrate; never
+            # the last one.
+            victim = min(
+                (w for w in live if not getattr(w, "warming", False)),
+                key=lambda w: w.daemon.queue.depth(), default=None)
+            if victim is not None and len(live) > 1:
+                self.router.drain_worker(victim.index, now)
 
     # -- the fleet loop ----------------------------------------------------
 
     def pump(self, *, drain: bool = False) -> int:
-        """One fleet round: every live worker pumps (its beat), then
-        health check, then a steal round. Returns batches dispatched."""
+        """One fleet round: deliver any bucket parked mid-steal, every
+        live worker pumps (its beat), then health check, a steal round,
+        and the elasticity tick. Returns batches dispatched."""
+        self.router.deliver_in_transit(self._clock())
         n = 0
         pumped = []
         for h in self.handles:
-            if h.wedged or h.halted:
+            if h.wedged or h.halted or h.drained:
                 continue
             n += h.daemon.pump(self._clock(), drain=drain)
             pumped.append(h)
@@ -153,17 +271,28 @@ class Fleet:
         # by definition, however long the round took (first dispatches
         # compile for whole seconds — per-worker stamps taken mid-round
         # would look stale against the round-end clock and false-wedge
-        # healthy workers). Only never-pumped (halted) workers go stale.
+        # healthy workers). The beat also covers WARMING workers — a
+        # rejoiner deserializing its AOT cache is alive but has not
+        # pumped yet; without the stamp the wedge horizon would re-
+        # declare it mid-warmup (the rejoin twin of the slow-pump
+        # false wedge). Only never-pumped (halted) workers go stale.
         now = self._clock()
         for h in pumped:
             h.last_beat = now
+            h.warming = False  # first completed pump ends the warmup
+        for h in self.handles:
+            if h.warming and not (h.wedged or h.drained):
+                h.last_beat = now
         self.router.check_health(now)
         if self._steal_enabled:
-            self.router.steal(self._clock())
+            self.router.steal(self._clock(), defer=True)
+        if self.controller is not None:
+            self._autoscale(now)
         return n
 
     def pending(self) -> int:
-        return sum(h.daemon.queue.depth() for h in self.handles)
+        return (sum(h.daemon.queue.depth() for h in self.handles)
+                + self.router.in_transit_depth())
 
     def serve_until_drained(self, *, drain: bool = False,
                             timeout_s: float = 120.0) -> None:
@@ -187,7 +316,12 @@ class Fleet:
     # -- accounting --------------------------------------------------------
 
     def resolved_tickets(self) -> list[Ticket]:
-        return [t for h in self.handles
+        """Every resolved ticket fleet-wide, INCLUDING the pre-failure
+        lifetimes of rejoined workers (retired handles) — the parity
+        gate and latency percentiles must cover work resolved before a
+        membership change, not just the current roster's."""
+        handles = list(self.handles) + list(self.router._retired)
+        return [t for h in handles
                 for t in h.daemon.queue.tickets() if t.state == DONE]
 
     def summary(self) -> dict:
@@ -199,6 +333,7 @@ class Fleet:
         books.update({
             "workers": len(self.handles),
             "wedged": list(self.router.wedged_workers),
+            "drained": list(self.router.drained_workers),
             "p50_latency_s": round(percentile(lat, 50), 6),
             "p99_latency_s": round(percentile(lat, 99), 6),
         })

@@ -192,6 +192,127 @@ def rollup(policies: Iterable[ServePolicy]) -> ServePolicy:
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class ElasticityPolicy:
+    """Knobs for the SLO-driven scaling loop, one immutable bundle.
+
+    The loop judges a live ``(p99, goodput/offered, depth)`` signal
+    against a declared SLO and decides ``add`` / ``drain`` / nothing.
+    Hysteresis is structural, not tuned-by-hope: an action needs
+    ``breach_k`` (or ``surplus_k``) CONSECUTIVE observations on the
+    same side, and after any action the controller holds still for
+    ``cooldown_k`` observations — a signal oscillating inside one
+    window can never flap the fleet, because neither streak completes.
+
+    ``surplus_p99_frac``/``surplus_depth`` define "provably idle":
+    scale-down needs the tail comfortably under SLO AND an (almost)
+    empty fleet-wide queue — draining a worker that still holds depth
+    would trade capacity for migration traffic at the worst moment.
+    """
+
+    slo_p99_s: float = 0.25
+    slo_goodput_frac: float = 0.9
+    min_workers: int = 1
+    max_workers: int = 8
+    breach_k: int = 3
+    surplus_k: int = 6
+    cooldown_k: int = 4
+    surplus_p99_frac: float = 0.5
+    surplus_depth: int = 0
+
+    def __post_init__(self):
+        if self.slo_p99_s <= 0:
+            raise ValueError(
+                f"slo_p99_s must be > 0, got {self.slo_p99_s}")
+        if not 0.0 < self.slo_goodput_frac <= 1.0:
+            raise ValueError(
+                f"slo_goodput_frac must be in (0, 1], got "
+                f"{self.slo_goodput_frac}")
+        if self.min_workers < 1:
+            raise ValueError(
+                f"min_workers must be >= 1, got {self.min_workers}")
+        if self.max_workers < self.min_workers:
+            raise ValueError(
+                f"max_workers ({self.max_workers}) must be >= "
+                f"min_workers ({self.min_workers})")
+        for name in ("breach_k", "surplus_k"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if self.cooldown_k < 0:
+            raise ValueError(
+                f"cooldown_k must be >= 0, got {self.cooldown_k}")
+        if not 0.0 <= self.surplus_p99_frac < 1.0:
+            raise ValueError(
+                f"surplus_p99_frac must be in [0, 1), got "
+                f"{self.surplus_p99_frac}")
+
+
+#: Controller verdicts (:meth:`ElasticController.observe`).
+SCALE_ADD = "add"
+SCALE_DRAIN = "drain"
+
+
+class ElasticController:
+    """Pure hysteresis state machine over the elasticity policy.
+
+    Clock-free and IO-free like everything else in this module: the
+    fleet loop feeds it one observation per evaluation window and acts
+    on the verdict; unit tests feed it synthetic signals and assert it
+    cannot flap. ``observe`` returns :data:`SCALE_ADD`,
+    :data:`SCALE_DRAIN`, or ``None``.
+    """
+
+    def __init__(self, policy: ElasticityPolicy | None = None):
+        self.policy = policy or ElasticityPolicy()
+        self.breach_streak = 0
+        self.surplus_streak = 0
+        self.cooldown = 0
+        self.actions: list[str] = []
+
+    def observe(self, *, p99_s: float, depth: int, workers: int,
+                goodput_rps: float | None = None,
+                offered_rps: float | None = None) -> str | None:
+        """Judge one evaluation window. ``p99_s`` is the live tail over
+        the window (0.0 = nothing resolved, which counts as a breach
+        only when work was offered), ``depth`` the fleet-wide pending
+        count, ``workers`` the current live worker count."""
+        pol = self.policy
+        starved = bool(offered_rps) and not goodput_rps
+        breach = p99_s > pol.slo_p99_s or starved
+        if (goodput_rps is not None and offered_rps is not None
+                and offered_rps > 0):
+            breach = breach or (goodput_rps
+                                < pol.slo_goodput_frac * offered_rps)
+        surplus = (p99_s < pol.surplus_p99_frac * pol.slo_p99_s
+                   and depth <= pol.surplus_depth and not starved)
+        if breach:
+            self.breach_streak += 1
+            self.surplus_streak = 0
+        elif surplus:
+            self.surplus_streak += 1
+            self.breach_streak = 0
+        else:
+            self.breach_streak = 0
+            self.surplus_streak = 0
+        if self.cooldown > 0:
+            self.cooldown -= 1
+            return None
+        if (self.breach_streak >= pol.breach_k
+                and workers < pol.max_workers):
+            return self._acted(SCALE_ADD)
+        if (self.surplus_streak >= pol.surplus_k
+                and workers > pol.min_workers):
+            return self._acted(SCALE_DRAIN)
+        return None
+
+    def _acted(self, verdict: str) -> str:
+        self.actions.append(verdict)
+        self.breach_streak = 0
+        self.surplus_streak = 0
+        self.cooldown = self.policy.cooldown_k
+        return verdict
+
+
 def percentile(values: list[float], q: float) -> float:
     """Nearest-rank percentile (``q`` in [0, 100]) — the p50/p99 the
     bench line publishes. 0.0 on an empty list so a fully-shed run still

@@ -270,6 +270,19 @@ class SessionPool:
         grouping hint only; compaction and spills move it."""
         return self._require(sid).handle
 
+    def slab_groups(self) -> dict[int | None, list[str]]:
+        """Live sessions grouped by resident slab (``None`` = spilled).
+        A membership change (graceful drain, rejoin claim) migrates one
+        group as a unit: slab-mates advance under one donated dispatch,
+        so scattering them across destinations would split one program
+        invocation into several padded ones — the whole-bucket rule of
+        the work stealer, applied to resident state."""
+        out: dict[int | None, list[str]] = {}
+        for sid, s in self._sessions.items():
+            key = s.handle.slab if s.handle is not None else None
+            out.setdefault(key, []).append(sid)
+        return out
+
     def steps_applied(self, sid: str) -> int:
         return self._require(sid).steps_applied
 

@@ -61,6 +61,7 @@ import hashlib
 
 import numpy as np
 
+from mpi_and_open_mp_tpu.robust import chaos
 from mpi_and_open_mp_tpu.serve import policy as policy_mod
 from mpi_and_open_mp_tpu.serve.policy import ServePolicy
 from mpi_and_open_mp_tpu.serve.queue import PENDING, SHED, Ticket
@@ -184,16 +185,37 @@ class FleetRouter:
         self.rehomes = 0  # re-home MOVES (one ticket moved twice = 2)
         self.pool_rehomed = 0  # resident sessions moved off wedged workers
         self.steals = 0
+        self.rejoins = 0
+        self.drains = 0
         self.wedged_workers: list[int] = []
+        self.drained_workers: list[int] = []
         #: Tickets adopted during the most recent wedge re-home — the
         #: bench kill drill reads their ``resolved_at`` stamps to
         #: measure recovery time.
         self.last_rehomed: list[Ticket] = []
+        #: Whole buckets released by a donor but not yet adopted by the
+        #: thief — the transfer window of a deferred steal. The door
+        #: counts these against the fleet (they are admitted work) while
+        #: neither worker's queue holds them, so a stolen bucket is
+        #: counted against exactly ONE owner at every instant: donor
+        #: before release, this ledger in transit, thief after adopt.
+        self._in_transit: list[dict] = []
+        #: Handles replaced by a REJOIN — their queues still hold the
+        #: shed/resolved history of the pre-failure lifetime, which the
+        #: fleet books must keep counting (a rejoin is a new lifetime
+        #: for the INDEX, not an amnesty for the old one's ledger).
+        self._retired: list = []
+        #: Session → worker-index directory. The ring names a session's
+        #: BIRTH worker; whole-slab-group migration (drain, rejoin
+        #: claims) may land a session off its ring point, and the verbs
+        #: must follow the session, not the hash.
+        self._session_home: dict[str, int] = {}
 
     # -- topology ----------------------------------------------------------
 
     def live_workers(self) -> list:
-        return [w for w in self._workers.values() if not w.wedged]
+        return [w for w in self._workers.values()
+                if not w.wedged and not getattr(w, "drained", False)]
 
     def worker(self, index: int):
         return self._workers[index]
@@ -221,6 +243,100 @@ class FleetRouter:
         self._recompute_rollup()
         trace.event("serve.fleet.join", worker=index,
                     live=len(self.live_workers()))
+
+    def rejoin_worker(self, worker, now: float) -> int:
+        """Re-admit a recovered worker under its old index — the
+        membership inverse of :meth:`declare_wedged` and the missing
+        half of :meth:`add_worker`.
+
+        Three rungs, in order. (1) **Ledger continuity**: the failed
+        lifetime's handle retires but its queue keeps counting in
+        :meth:`books` — a rejoin is a new lifetime for the index, never
+        an amnesty for the old one's re-homed sheds. (2) **Bounded
+        ring re-entry**: the index returns to its OLD ring points
+        (``_h64`` is a pure function of ``(seed, index, replica)``), so
+        exactly the keys that left when it wedged come back — expected
+        ``sessions/(N+1)`` movement, nothing else shifts. (3) **The
+        claim pass**: every whole slab group whose lead session now
+        lands on the rejoiner's points migrates back — journaled
+        destination-first (``adopt_session`` writes CREATE+STEP on the
+        rejoiner's WAL, the ``post-rejoin`` crash site fires between
+        the handshake halves, then the donor's EVICT closes its books)
+        and bit-exact (the claim carries the ORIGIN create board plus
+        the journaled step total; the rejoiner's device replays the
+        advance). Pending tickets do NOT move — they finish at their
+        current owners; only placement-sticky resident state follows
+        the ring. Returns the number of sessions claimed.
+
+        The caller hands in a FRESH handle (new daemon resumed from the
+        victim's own journal — which a completed wedge re-home left
+        empty, so the rejoiner adopts nothing it no longer owns) and is
+        responsible for the warming heartbeat cover while the rejoiner
+        fills its AOT cache (``serve.fleet`` stamps ``warming`` handles
+        in the shared post-round beat)."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        index = int(worker.index)
+        old = self._workers.get(index)
+        if old is worker:
+            raise ValueError(
+                f"worker {index} rejoin needs a fresh handle, not the "
+                "failed lifetime's own")
+        if old is not None:
+            if not (old.wedged or getattr(old, "drained", False)):
+                raise ValueError(
+                    f"worker {index} is live; rejoin re-admits a wedged "
+                    "or drained worker (add_worker admits new ones)")
+            self._retired.append(old)
+        if worker.wedged or getattr(worker, "drained", False):
+            raise ValueError(
+                f"worker {index} rejoin handle arrives pre-failed")
+        self._workers[index] = worker
+        self.ring.add_worker(index)
+        self._recompute_rollup()
+        claimed = self._claim_sessions(worker, now)
+        self.rejoins += 1
+        metrics.inc("serve.fleet.rejoins")
+        trace.event("serve.fleet.rejoin", worker=index, claimed=claimed,
+                    live=len(self.live_workers()))
+        return claimed
+
+    def _claim_sessions(self, dest, now: float) -> int:
+        """Move every whole slab group whose LEAD session's ring
+        affinity is ``dest`` from its current owner. Whole groups only:
+        slab-mates advance under one donated dispatch, and the lead
+        (first-created) session decides the group's placement so one
+        hash lookup moves one program's worth of state."""
+        claimed = 0
+        for src in list(self.live_workers()):
+            if src.index == dest.index:
+                continue
+            groups = (src.daemon.pool.slab_groups()
+                      if src.daemon._pool is not None
+                      else {None: list(src.daemon._session_log)})
+            for _, sids in groups.items():
+                sids = [s for s in sids if s in src.daemon._session_log]
+                if not sids:
+                    continue
+                if self.ring.lookup(str(sids[0])) != dest.index:
+                    continue
+                for sid in sids:
+                    self._migrate_session(src, dest, sid)
+                    claimed += 1
+        return claimed
+
+    def _migrate_session(self, src, dest, sid: str) -> None:
+        """One session's membership move, destination-journal-first:
+        the dest WAL gets a fresh CREATE+STEP lifetime (bit-exact —
+        origin create board + journaled step total), then the source's
+        EVICT frame closes its books. A crash between the halves leaves
+        the session live in BOTH journals with identical resumable
+        state: duplicated, never lost."""
+        entry = src.daemon._session_log[sid]
+        dest.daemon.adopt_session(sid, entry["board"], int(entry["steps"]))
+        src.daemon.evict_session(sid)
+        self._session_home[str(sid)] = dest.index
+        self.pool_rehomed += 1
 
     # -- routing + global admission ----------------------------------------
 
@@ -260,6 +376,20 @@ class FleetRouter:
             for key, n in q._bucket_counts().items():
                 counts[key] = counts.get(key, 0) + n
                 widths.setdefault(key, q._slice_width(key))
+        # Buckets parked in a steal/drain transfer window belong to the
+        # fleet but to NEITHER queue right now — without this the door
+        # would judge a depth that forgets admitted work mid-move (the
+        # historical bug was worse: the synchronous steal double-counted
+        # the bucket at donor AND thief for one round of estimates).
+        for parked in self._in_transit:
+            for e in parked["entries"]:
+                b = np.asarray(e["board"])
+                key = (b.shape, b.dtype.str, int(e["steps"]),
+                       str(e.get("workload", "life")))
+                depth += 1
+                counts[key] = counts.get(key, 0) + 1
+                widths.setdefault(key,
+                                  target.daemon.queue._slice_width(key))
         cand = ((board.shape, board.dtype.str, int(steps)))
         counts[cand] = counts.get(cand, 0) + 1
         widths.setdefault(cand, target.daemon.queue._slice_width(cand))
@@ -276,21 +406,42 @@ class FleetRouter:
     # step total — one board crosses the wire, the destination's device
     # replays the advance).
 
+    def _home_worker(self, session: str):
+        """The worker actually holding ``session``. The directory
+        (``_session_home``) wins over the ring: whole-slab-group moves
+        (drain, rejoin claims) may place a session off its hash point,
+        and a verb routed by hash alone would miss it."""
+        sid = str(session)
+        idx = self._session_home.get(sid)
+        if idx is not None:
+            w = self._workers.get(idx)
+            if (w is not None and not w.wedged
+                    and not getattr(w, "drained", False)):
+                return w
+        return self._workers[self.ring.lookup(sid)]
+
     def create_session(self, session: str, board, now: float):
-        return self._workers[self.ring.lookup(str(session))].daemon \
-            .create_session(session, board)
+        w = self._workers[self.ring.lookup(str(session))]
+        handle = w.daemon.create_session(session, board)
+        self._session_home[str(session)] = w.index
+        return handle
 
     def step_session(self, session: str, steps: int, now: float) -> Ticket:
-        return self._workers[self.ring.lookup(str(session))].daemon \
+        # A resident step is a submission like any other: it admits a
+        # ticket at its home worker, and the books identity
+        # ``submitted == admitted + door_shed`` must keep holding when
+        # traffic mixes one-shot boards with session steps.
+        self.submitted += 1
+        return self._home_worker(session).daemon \
             .submit_session(session, steps)
 
     def snapshot_session(self, session: str):
-        return self._workers[self.ring.lookup(str(session))].daemon \
-            .snapshot_session(session)
+        return self._home_worker(session).daemon.snapshot_session(session)
 
     def evict_session(self, session: str):
-        return self._workers[self.ring.lookup(str(session))].daemon \
-            .evict_session(session)
+        board = self._home_worker(session).daemon.evict_session(session)
+        self._session_home.pop(str(session), None)
+        return board
 
     # -- failure isolation -------------------------------------------------
 
@@ -328,32 +479,41 @@ class FleetRouter:
         self.wedged_workers.append(index)
         self._recompute_rollup()
 
-        entries, pool_sessions = self._drain_victim(victim, now)
-        adopted: list[Ticket] = []
-        by_target: dict[int, list[dict]] = {}
-        for e in entries:
-            key = affinity_key(e.get("session"), e.get("id"))
-            by_target.setdefault(self.ring.lookup(key), []).append(e)
-        for tgt_index, group in by_target.items():
-            adopted.extend(
-                self._workers[tgt_index].daemon.adopt(group, now))
-        # Re-home the victim's RESIDENT SESSIONS: the ring minus the
-        # victim names each session's new pool, and adopt_session
-        # journals a fresh CREATE+STEP lifetime there before the
-        # destination device replays the advance — the re-home carries
-        # a snapshot-equivalent (create board + step total), never the
-        # raw slab.
-        for sid, entry in pool_sessions.items():
-            tgt = self._workers[self.ring.lookup(str(sid))]
-            tgt.daemon.adopt_session(sid, entry["board"],
-                                     int(entry["steps"]))
-            # Close the victim's books: an EVICT frame per moved session
-            # (the pool twin of the re-homed SHED) makes a second replay
-            # of the victim's journal find nothing live.
-            if victim.daemon._wal is not None:
-                victim.daemon._wal.pool_evict(sid)
-            victim.daemon._session_log.pop(sid, None)
-            self.pool_rehomed += 1
+        # The whole re-home runs under chaos suppression — it is a
+        # RECOVERY path, and by the repo's convention (daemon fallback
+        # engines, fleet CLI strip_chaos) the fault that killed the
+        # victim must not re-kill the redo. Planned membership moves
+        # (rejoin claims, graceful drains) stay instrumented: their
+        # ``post-rejoin``/``mid-drain`` sites fire outside this block.
+        with chaos.suppressed():
+            entries, pool_sessions = self._drain_victim(victim, now)
+            adopted: list[Ticket] = []
+            by_target: dict[int, list[dict]] = {}
+            for e in entries:
+                key = affinity_key(e.get("session"), e.get("id"))
+                by_target.setdefault(self.ring.lookup(key), []).append(e)
+            for tgt_index, group in by_target.items():
+                adopted.extend(
+                    self._workers[tgt_index].daemon.adopt(group, now))
+            # Re-home the victim's RESIDENT SESSIONS: the ring minus the
+            # victim names each session's new pool, and adopt_session
+            # journals a fresh CREATE+STEP lifetime there before the
+            # destination device replays the advance — the re-home
+            # carries a snapshot-equivalent (create board + step total),
+            # never the raw slab.
+            for sid, entry in pool_sessions.items():
+                tgt = self._workers[self.ring.lookup(str(sid))]
+                tgt.daemon.adopt_session(sid, entry["board"],
+                                         int(entry["steps"]))
+                self._session_home[str(sid)] = tgt.index
+                # Close the victim's books: an EVICT frame per moved
+                # session (the pool twin of the re-homed SHED) makes a
+                # second replay of the victim's journal find nothing
+                # live.
+                if victim.daemon._wal is not None:
+                    victim.daemon._wal.pool_evict(sid)
+                victim.daemon._session_log.pop(sid, None)
+                self.pool_rehomed += 1
         self.rehomes += len(entries)
         self.last_rehomed = adopted
         metrics.inc("serve.fleet.wedged")
@@ -397,18 +557,143 @@ class FleetRouter:
             })
         return entries, rep.pool_sessions
 
+    # -- graceful drain ----------------------------------------------------
+
+    def drain_worker(self, index: int, now: float) -> dict:
+        """Gracefully remove a LIVE worker — the planned inverse of
+        :meth:`declare_wedged`, with the luxury a wedge never has: the
+        worker is still trustworthy, so the handoff can be ordered for
+        zero loss instead of reconstructed from a journal post mortem.
+
+        The ladder: (1) **cordon** — off the ring and out of the
+        rolled-up door budget, so no new work routes to it while its
+        backlog unwinds; (2) **board buckets migrate whole** — each
+        pending bucket adopts at ONE survivor picked by its lead
+        ticket's affinity, destination journal first (the ``mid-drain``
+        crash site fires between the adopt and the source's
+        ``re-homed`` SHED — a kill there duplicates one bucket, never
+        loses it); (3) **resident-step tickets finish locally** — their
+        STEP frames are already journaled and authoritative here, so
+        they dispatch before the pool moves rather than risk a
+        double-apply; (4) **resident sessions migrate whole slab
+        groups** (never splitting one — slab-mates share a donated
+        dispatch) to each group's lead-session affinity; (5) **WAL
+        compact + handoff** — the drained journal rotates around its
+        now-empty pending set and syncs, so the handoff receipt is
+        durable: a later replay of the drained worker's journal finds
+        nothing live. Returns the migration stats dict."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        victim = self._workers[index]
+        if victim.wedged or getattr(victim, "drained", False):
+            raise ValueError(
+                f"worker {index} already left the fleet; drain is for "
+                "live workers (a wedge is declared, not drained)")
+        survivors = [w for w in self.live_workers() if w.index != index]
+        if not survivors:
+            raise RuntimeError(
+                f"cannot drain worker {index}: no survivors to adopt "
+                "its work")
+        # (1) Cordon at the door: off the ring, out of the rollup. The
+        # worker stays pumpable (not wedged/drained yet) so its pool
+        # tickets can finish below.
+        victim.cordoned = True
+        self.ring.remove_worker(index)
+        self._recompute_rollup_excluding(index)
+        trace.event("serve.fleet.cordon", worker=index)
+
+        # (2) Whole board buckets, destination-journal-first.
+        moved_tickets = 0
+        for key, group in list(victim.daemon.queue.buckets().items()):
+            if key[0] == "pool":
+                continue
+            lead = group[0]
+            tgt = self._workers[self.ring.lookup(
+                affinity_key(lead.session, lead.id))]
+            entries = victim.daemon.export(group, now)
+            tgt.daemon.adopt(entries, now)
+            # Instrumented crash site: the bucket is journaled at the
+            # destination, the source's re-homed SHED is not — a kill
+            # here re-dispatches the bucket at both on recovery
+            # (duplicated, dispatch is pure) instead of at neither.
+            if chaos.crash_armed("mid-drain"):
+                chaos.crash_now()
+            victim.daemon._shed_batch(group, policy_mod.SHED_REHOMED, now)
+            moved_tickets += len(entries)
+            self.rehomes += len(entries)
+
+        # (3) Resident-step tickets finish here: their journaled STEP
+        # frames are authoritative on THIS worker until the session
+        # moves; migrating the session below carries their effect.
+        rounds = 0
+        while any(t.handle is not None
+                  for t in victim.daemon.queue.pending()):
+            victim.daemon.pump(now, drain=True)
+            rounds += 1
+            if rounds > 1000:
+                raise RuntimeError(
+                    f"worker {index} failed to finish its resident-step "
+                    "tickets while draining")
+
+        # (4) Resident sessions, whole slab groups, lead-session
+        # affinity.
+        moved_sessions = 0
+        groups = (victim.daemon.pool.slab_groups()
+                  if victim.daemon._pool is not None
+                  else {None: list(victim.daemon._session_log)})
+        for _, sids in groups.items():
+            sids = [s for s in sids if s in victim.daemon._session_log]
+            if not sids:
+                continue
+            tgt = self._workers[self.ring.lookup(str(sids[0]))]
+            for sid in sids:
+                self._migrate_session(victim, tgt, sid)
+                moved_sessions += 1
+
+        # (5) Compact + hand off the journal: the rotation snapshot is
+        # the receipt — pending and pool both empty, durably.
+        if victim.daemon._wal is not None:
+            victim.daemon._compact_wal()
+            victim.daemon._wal.sync()
+        victim.drained = True
+        self.drains += 1
+        self.drained_workers.append(index)
+        metrics.inc("serve.fleet.drains")
+        trace.event("serve.fleet.drained", worker=index,
+                    tickets=moved_tickets, sessions=moved_sessions,
+                    survivors=len(survivors))
+        return {"worker": index, "tickets_moved": moved_tickets,
+                "sessions_moved": moved_sessions,
+                "survivors": len(survivors)}
+
+    def _recompute_rollup_excluding(self, index: int) -> None:
+        live = [w for w in self.live_workers()
+                if w.index != index and not getattr(w, "cordoned", False)]
+        if live:
+            self._rollup = policy_mod.rollup(w.daemon.policy for w in live)
+
     # -- work stealing -----------------------------------------------------
 
-    def steal(self, now: float) -> int:
+    def steal(self, now: float, *, defer: bool = False) -> int:
         """Move the oldest whole bucket from the deepest backlogged
         worker to an idle one. Whole buckets only — a bucket is one
         compiled program's worth of same-shape work (one 32-board plane
         group when bitsliced); splitting it buys a second padded
         dispatch for zero latency win. The donor keeps at least one
         bucket (stealing its last one just moves the wait). Returns the
-        number of tickets moved (0 = no steal this round)."""
-        from mpi_and_open_mp_tpu.obs import metrics, trace
+        number of tickets moved (0 = no steal this round).
 
+        The move is two-phase: the donor releases the bucket into the
+        router's in-transit ledger, then the thief adopts it from
+        there. Between the phases the bucket is counted against the
+        LEDGER at the door (see :meth:`_door_verdict`) and against
+        neither queue — so a stolen bucket has exactly one owner at
+        every instant, where the old synchronous move briefly showed
+        the same depth at donor and thief. ``defer=True`` stops after
+        the park (the fleet pump delivers at the next round start, so
+        the thief's door estimate settles before it adopts);
+        ``defer=False`` keeps the synchronous contract for direct
+        callers by delivering immediately."""
         live = self.live_workers()
         idle = [w for w in live if w.daemon.queue.depth() == 0]
         if not idle:
@@ -424,27 +709,65 @@ class FleetRouter:
         _, group = min(buckets.items(), key=lambda kv: kv[1][0].id)
         thief = min(idle, key=lambda w: w.index)
         entries = donor.daemon.release(group, now)
-        thief.daemon.adopt(entries, now)
-        self.steals += 1
-        self.rehomes += len(entries)
-        metrics.inc("serve.fleet.steals")
-        trace.event("serve.fleet.steal", donor=donor.index,
-                    thief=thief.index, tickets=len(entries))
-        return len(entries)
+        self._in_transit.append({
+            "entries": entries, "donor": donor.index,
+            "thief": thief.index,
+        })
+        moved = len(entries)
+        if not defer:
+            self.deliver_in_transit(now)
+        return moved
+
+    def deliver_in_transit(self, now: float) -> int:
+        """Land every parked steal at its thief. If the thief left the
+        fleet while the bucket was in transit (wedged or drained
+        between park and delivery), the bucket re-routes by its lead
+        entry's ring affinity — parked work is admitted work; it never
+        evaporates with its intended recipient. Returns tickets
+        delivered."""
+        from mpi_and_open_mp_tpu.obs import metrics, trace
+
+        delivered = 0
+        parked, self._in_transit = self._in_transit, []
+        for move in parked:
+            entries = move["entries"]
+            thief = self._workers.get(move["thief"])
+            if (thief is None or thief.wedged
+                    or getattr(thief, "drained", False)):
+                lead = entries[0]
+                thief = self._workers[self.ring.lookup(
+                    affinity_key(lead.get("session"), lead.get("id")))]
+            thief.daemon.adopt(entries, now)
+            delivered += len(entries)
+            self.steals += 1
+            self.rehomes += len(entries)
+            metrics.inc("serve.fleet.steals")
+            trace.event("serve.fleet.steal", donor=move["donor"],
+                        thief=thief.index, tickets=len(entries))
+        return delivered
+
+    def in_transit_depth(self) -> int:
+        """Tickets parked between a donor's release and the thief's
+        adopt. Part of the fleet's pending surface: drain loops must not
+        declare the fleet empty while a bucket is mid-move."""
+        return sum(len(m["entries"]) for m in self._in_transit)
 
     # -- accounting --------------------------------------------------------
 
     def books(self) -> dict:
         """Fleet-wide accounting across every worker that ever held a
-        ticket. Each request is counted once, at its final owner: a
-        re-home is one ``re-homed`` shed at the source plus one adopted
-        ticket at the destination, and the two must cancel —
-        ``balanced`` asserts both the shed/adopt pairing and the ISSUE
-        equation ``admitted == resolved + shed + pending`` with
-        re-homed moves netted out."""
+        ticket — including handles retired by a REJOIN, whose queues
+        still carry the failed lifetime's history. Each request is
+        counted once, at its final owner: a re-home is one ``re-homed``
+        shed at the source plus one adopted ticket at the destination
+        (or one parked in-transit entry mid-steal), and the two must
+        cancel — ``balanced`` asserts the shed/adopt pairing and the
+        ISSUE equation ``admitted == resolved + shed + pending`` with
+        re-homed moves netted out and the in-transit window counted as
+        pending-elsewhere."""
         admitted = resolved = shed_real = rehomed_shed = pending = 0
         adopted = rehomed_resolved = 0
-        for w in self._workers.values():
+        for w in list(self._workers.values()) + list(self._retired):
             for t in w.daemon.queue.tickets():
                 if t.resumed:
                     adopted += 1
@@ -461,6 +784,7 @@ class FleetRouter:
                     if t.resumed:
                         rehomed_resolved += 1
         door = sum(self.door_shed.values())
+        in_transit = self.in_transit_depth()
         return {
             "submitted": self.submitted,
             "door_shed": door,
@@ -471,7 +795,11 @@ class FleetRouter:
             "rehomed": rehomed_shed,
             "rehomed_resolved": rehomed_resolved,
             "steals": self.steals,
-            "balanced": (rehomed_shed == adopted
-                         and admitted == resolved + shed_real + pending
+            "rejoins": self.rejoins,
+            "drains": self.drains,
+            "in_transit": in_transit,
+            "balanced": (rehomed_shed == adopted + in_transit
+                         and admitted
+                         == resolved + shed_real + pending + in_transit
                          and self.submitted == admitted + door),
         }

@@ -166,17 +166,22 @@ class WALReplay:
     including the ``in_flight_ids`` of an open ``DISPATCH`` (redispatch
     is idempotent, so they simply rejoin the queue). ``resolved_ids`` /
     ``shed_ids`` close the books: every id the dead process journaled
-    terminal. ``pool_sessions`` maps live session id → ``{id, board,
-    steps, wall}`` — the create board plus the summed journaled step
-    count, which *is* the session's resumable state (re-materialize by
-    advancing ``board`` ``steps`` generations). ``truncated_at`` is the
-    byte offset of a torn tail (``None`` for a clean EOF).
+    terminal. ``shed_reasons`` splits the shed set per policy reason —
+    a membership audit needs to tell a ``re-homed`` handoff (which must
+    pair with an adoption on some OTHER worker's journal) from a real
+    terminal shed. ``pool_sessions`` maps live session id → ``{id,
+    board, steps, wall}`` — the create board plus the summed journaled
+    step count, which *is* the session's resumable state (re-materialize
+    by advancing ``board`` ``steps`` generations). ``truncated_at`` is
+    the byte offset of a torn tail (``None`` for a clean EOF).
     """
 
     pending: list[dict]
     in_flight_ids: set[int]
     resolved_ids: set[int]
     shed_ids: set[int]
+    shed_reasons: dict[str, set[int]] = dataclasses.field(
+        default_factory=dict)
     pool_sessions: dict[str, dict] = dataclasses.field(default_factory=dict)
     generation: int = 0
     frames: int = 0
@@ -279,10 +284,12 @@ def replay(path: str | os.PathLike) -> WALReplay:
                 rep.in_flight_ids.discard(int(tid))
                 rep.resolved_ids.add(int(tid))
         elif rtype == "SHED":
+            reason = str(rec.get("reason", ""))
             for tid in rec["ids"]:
                 pending.pop(int(tid), None)
                 rep.in_flight_ids.discard(int(tid))
                 rep.shed_ids.add(int(tid))
+                rep.shed_reasons.setdefault(reason, set()).add(int(tid))
         elif rtype == "CREATE":
             sid = str(rec["id"])
             if sid in rep.pool_sessions:

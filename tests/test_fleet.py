@@ -445,3 +445,286 @@ def test_fleet_wedge_rehomes_pool_sessions(tmp_path, make_board):
     assert t.state == DONE
     np.testing.assert_array_equal(
         f.snapshot_session("sess-0"), oracle_n(boards["sess-0"], 5))
+
+
+# ----------------------------------------------- REJOIN + drain + elasticity
+
+
+def _claimable_sessions(fleet, worker, count, make_board):
+    """Session names whose FULL-ring affinity is ``worker``, each a
+    DISTINCT shape (its own slab group — the whole-group rule moves it
+    alone at rejoin time)."""
+    from mpi_and_open_mp_tpu.serve.router import ConsistentHashRing
+
+    full = ConsistentHashRing(sorted({h.index for h in fleet.handles}))
+    out, i = {}, 0
+    while len(out) < count:
+        name = f"claim-{i}"
+        i += 1
+        if full.lookup(name) == worker:
+            # Never 16x16: the claimable sessions must not join the
+            # survivors' existing 16x16 slab group (whose lead is the
+            # survivor's own session and would pin the whole group).
+            shape = 18 + 2 * len(out)
+            out[name] = make_board(shape, 16)
+    return out
+
+
+def test_rejoin_reenters_ring_and_claims_bit_exact(tmp_path, make_board):
+    """The full REJOIN ladder: wedge → recover → rejoin under the old
+    index. Bounded re-entry (victim-affine keys route to it again),
+    bit-exact claims (whole slab groups whose lead hashes to the
+    rejoiner migrate back, snapshots oracle-identical), warming handle,
+    and books that balance across BOTH membership changes."""
+    pol = ServePolicy(max_batch=4, max_wait_s=0.0)
+    f, clk = _fleet(3, pol, wal_dir=str(tmp_path), steal=False,
+                    heartbeat_interval_s=0.02)
+    boards = {f"sess-{i}": make_board(16, 16) for i in range(9)}
+    for sid, b in boards.items():
+        f.create_session(sid, b)
+    for sid in boards:
+        f.step_session(sid, 2)
+    f.serve_until_drained()
+
+    victim = f.router.target_for("sess-0")
+    f.wedge(victim)
+    for _ in range(6):
+        f.pump()
+        clk.sleep(0.02)
+    assert f.handles[victim].wedged
+
+    # Sessions created while the victim is out, whose affinity on the
+    # FULL ring is the victim: the rejoin claim pass must move exactly
+    # these back (each its own slab group via a distinct shape).
+    claim = _claimable_sessions(f, victim, 3, make_board)
+    for sid, b in claim.items():
+        f.create_session(sid, b)
+        f.step_session(sid, 2)
+    f.serve_until_drained()
+
+    with pytest.raises(ValueError, match="is live"):
+        f.rejoin_worker((victim + 1) % 3)
+    claimed = f.rejoin_worker(victim)
+    fresh = next(h for h in f.handles if h.index == victim)
+    assert fresh.warming and not fresh.wedged
+    assert claimed >= len(claim)
+    assert f.router.rejoins == 1
+    # Bounded re-entry: the old ring points are back, so victim-affine
+    # keys route to the rejoiner again.
+    assert f.router.target_for("sess-0") == victim
+    # Claims are bit-exact at the rejoiner.
+    for sid, b in claim.items():
+        assert f.router._home_worker(sid).index == victim
+        np.testing.assert_array_equal(
+            f.snapshot_session(sid), oracle_n(b, 2),
+            err_msg=f"claimed session {sid} lost parity across rejoin")
+    # The fleet serves through the rejoiner again, books balanced over
+    # the retired lifetime + the new one.
+    t = f.step_session("sess-0", 3)
+    f.serve_until_drained()
+    assert t.state == DONE
+    s = f.summary()
+    assert s["balanced"] and s["rejoins"] == 1
+    assert fresh.warming is False  # first completed pump cleared it
+
+
+def test_rejoin_warming_worker_not_false_wedged(tmp_path, make_board):
+    """The satellite fix: a rejoined worker still deserializing its AOT
+    cache (alive, not yet pumping) must be covered by the shared
+    post-round beat — before the fix its stale stamp would re-wedge it
+    mid-warmup after one horizon."""
+    pol = ServePolicy(max_batch=4, max_wait_s=0.0)
+    f, clk = _fleet(3, pol, wal_dir=str(tmp_path), steal=False,
+                    heartbeat_interval_s=0.02)
+    for i in range(6):
+        f.submit(make_board(16, 16), 2, session=f"s{i}")
+    victim = 0
+    f.wedge(victim)
+    f.serve_until_drained()
+    assert f.handles[victim].wedged
+
+    f.rejoin_worker(victim)
+    fresh = next(h for h in f.handles if h.index == victim)
+    assert fresh.warming
+    # Simulate a long warmup: the rejoiner cannot pump yet, and many
+    # wedge horizons pass under live traffic.
+    fresh.halted = True
+    for i in range(8):
+        f.submit(make_board(16, 16), 2, session=f"w{i}")
+        f.pump()
+        clk.sleep(0.05)  # 2.5 horizons per round
+    assert not fresh.wedged, "warming worker was false-wedged"
+    # Warmup ends: it pumps, clears the flag, and serves.
+    fresh.halted = False
+    f.serve_until_drained()
+    assert not fresh.warming and not fresh.wedged
+    assert f.summary()["balanced"]
+    # A worker that is NOT warming still wedges on the same staleness —
+    # the cover is for warmup, not amnesty.
+    f.wedge(2)
+    for _ in range(6):
+        f.pump()
+        clk.sleep(0.05)
+    assert f.handles[2].wedged
+
+
+def test_steal_in_transit_counted_once_at_door(make_board):
+    """The satellite fix: a stolen bucket between release and adopt
+    belongs to the FLEET (the in-transit ledger) and to neither queue —
+    the door must count it exactly once and the books must balance
+    mid-move."""
+    pol = ServePolicy(max_batch=4, max_depth=3, max_wait_s=100.0)
+    f, clk = _fleet(2, pol, steal=False)
+    donor = _session_for(f, 0)
+    b16, b24 = make_board(16, 16), make_board(24, 24)
+    for _ in range(2):
+        f.submit(b16, 2, session=donor)
+    f.submit(b24, 2, session=donor)
+
+    moved = f.router.steal(clk(), defer=True)
+    assert moved == 2  # the (16,16) bucket parked, not yet adopted
+    assert f.router.in_transit_depth() == 2
+    assert [h.daemon.queue.depth() for h in f.handles] == [1, 0]
+    assert f.pending() == 3  # parked work is still pending work
+    books = f.router.books()
+    assert books["in_transit"] == 2 and books["balanced"], books
+
+    # The door counts the parked bucket: fleet-wide depth is 3 of a
+    # rolled 6, so exactly 3 more admissions fit.
+    cold = _session_for(f, 1)
+    for _ in range(3):
+        assert f.submit(b16, 2, session=cold).state == PENDING
+    # The 7th submit targets the DONOR (local depth 1 of 3 — its own
+    # door would admit): only the fleet door counting the 2 parked
+    # tickets sees depth 6 of the rolled 6 and sheds.
+    t = f.submit(b16, 2, session=donor)
+    assert t.state == SHED and t.id < 0, (
+        "door forgot the in-transit bucket")
+
+    delivered = f.router.deliver_in_transit(clk())
+    assert delivered == 2 and f.router.in_transit_depth() == 0
+    assert f.router.steals == 1
+    f.serve_until_drained(drain=True)
+    s = f.summary()
+    assert s["balanced"] and s["resolved"] == 6 and s["in_transit"] == 0
+
+
+def test_steal_in_transit_reroutes_if_thief_dies(make_board):
+    """A bucket parked for a thief that wedges mid-transfer re-routes
+    by ring affinity instead of evaporating with its recipient."""
+    pol = ServePolicy(max_batch=4, max_wait_s=100.0)
+    f, clk = _fleet(3, pol, steal=False, heartbeat_interval_s=0.02)
+    donor = _session_for(f, 0)
+    for _ in range(2):
+        f.submit(make_board(16, 16), 2, session=donor)
+    f.submit(make_board(24, 24), 2, session=donor)
+    moved = f.router.steal(clk(), defer=True)
+    assert moved == 2
+    thief = f.router._in_transit[0]["thief"]
+    f.router.declare_wedged(thief, clk())
+    assert f.handles[thief].wedged
+    assert f.router.deliver_in_transit(clk()) == 2
+    f.serve_until_drained(drain=True)
+    s = f.summary()
+    assert s["balanced"] and s["resolved"] == 3 and s["pending"] == 0
+
+
+def test_drain_worker_moves_whole_buckets_zero_loss(tmp_path, make_board):
+    """Graceful drain: cordoned at the door, board buckets migrate
+    WHOLE (one destination per bucket), resident-step tickets finish
+    locally, slab groups move unsplit, and the compacted journal is the
+    handoff receipt — a replay finds nothing live. Zero acked loss,
+    oracle parity end to end."""
+    pol = ServePolicy(max_batch=4, max_wait_s=100.0)
+    f, clk = _fleet(3, pol, wal_dir=str(tmp_path), steal=False)
+    victim = 0
+    vsess = _session_for(f, victim)
+    boards = [make_board(16, 16) for _ in range(3)]
+    tickets = [f.submit(b, 2, session=vsess) for b in boards]
+    assert all(t.state == PENDING for t in tickets)
+    assert f.handles[victim].daemon.queue.depth() == 3
+    # A resident session on the victim with a journaled, undispatched
+    # step the drain must flush locally before the pool moves.
+    sb = make_board(16, 16)
+    f.create_session(vsess, sb)
+    st = f.step_session(vsess, 2)
+
+    stats = f.drain_worker(victim)
+    assert f.handles[victim].drained and f.handles[victim].cordoned
+    assert stats["tickets_moved"] == 3 and stats["sessions_moved"] == 1
+    assert st.state == DONE  # finished locally, never migrated
+    # Whole-bucket rule: all three tickets landed at ONE survivor.
+    depths = [h.daemon.queue.depth() for h in f.handles
+              if h.index != victim]
+    assert sorted(depths) == [0, 3]
+    # Cordoned at the router door: nothing routes to it anymore.
+    assert all(f.router.target_for(f"probe-{i}") != victim
+               for i in range(50))
+    # The handoff receipt: the drained journal replays to empty.
+    rep = wal_mod.replay(str(tmp_path / f"worker{victim}.wal"))
+    assert rep.pending == [] and rep.pool_sessions == {}
+
+    f.serve_until_drained(drain=True)
+    s = f.summary()
+    assert s["balanced"] and s["drains"] == 1
+    assert s["drained"] == [victim]
+    assert s["resolved"] == 4 and s["pending"] == 0  # zero acked loss
+    for t in f.resolved_tickets():
+        if t.board is not None:
+            np.testing.assert_array_equal(
+                t.result, oracle_n(t.board, t.steps),
+                err_msg=f"ticket {t.id} lost parity across the drain")
+    np.testing.assert_array_equal(f.snapshot_session(vsess),
+                                  oracle_n(sb, 2))
+    with pytest.raises(ValueError, match="already left"):
+        f.drain_worker(victim)
+
+
+def test_drain_last_survivor_refused():
+    f, _clk = _fleet(2, ServePolicy(max_batch=4, max_wait_s=0.0))
+    f.drain_worker(0)
+    with pytest.raises(RuntimeError, match="no survivors"):
+        f.drain_worker(1)
+
+
+def test_autoscale_adds_on_breach_drains_on_surplus(make_board):
+    """The SLO loop end to end: sustained p99 breach grows the fleet
+    (after breach_k consecutive breaches, never during cooldown),
+    sustained surplus drains it back — and the action log shows two
+    clean decisions, not a flap."""
+    elastic = policy_mod.ElasticityPolicy(
+        slo_p99_s=0.01, min_workers=2, max_workers=3,
+        breach_k=2, surplus_k=3, cooldown_k=2)
+    pol = ServePolicy(max_batch=4, max_wait_s=0.0)
+    f, clk = _fleet(2, pol, steal=False, elasticity=elastic,
+                    elastic_window_s=5.0)
+    assert f.controller is not None
+
+    # Breach: every resolved ticket waits ~0.05s >> the 0.01s SLO.
+    rounds_before = len(f.handles)
+    for i in range(4):
+        f.submit(make_board(16, 16), 2, session=f"s{i}")
+        clk.sleep(0.05)
+        f.pump()
+    assert len(f.handles) == rounds_before + 1 == 3
+    assert f.controller.actions == [policy_mod.SCALE_ADD]
+    new = f.handles[-1]
+    assert new.index == 2 and not new.wedged  # next free index
+    # ... and max_workers caps further growth even under breach.
+    for i in range(6):
+        f.submit(make_board(16, 16), 2, session=f"b{i}")
+        clk.sleep(0.05)
+        f.pump()
+    assert len(f.handles) == 3
+
+    # Surplus: quiet fleet, p99 window empties, depth zero → after
+    # cooldown + surplus_k the shallowest worker drains.
+    f.serve_until_drained(drain=True)
+    clk.sleep(10.0)  # age the window out
+    for _ in range(8):
+        f.pump()
+        clk.sleep(0.01)
+    assert f.controller.actions == [policy_mod.SCALE_ADD,
+                                    policy_mod.SCALE_DRAIN]
+    assert len(f.router.live_workers()) == 2  # back at min capacity
+    assert f.summary()["balanced"]

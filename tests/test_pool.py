@@ -276,6 +276,27 @@ def test_submit_session_depth_gate_and_unknown(rng):
             oracle_n(dm._session_log[f"s{i}"]["board"], steps))
 
 
+def test_concurrent_steps_same_session_all_apply(rng):
+    # Open-loop traffic parks several steps for ONE session in the same
+    # bucket before any pump reaches them. `step_group` ORs lanes into
+    # a dispatch mask, so duplicate sessions in one chunk would collapse
+    # to a single advance while every ticket resolves DONE — the daemon
+    # must split such a chunk into waves of distinct sessions (the
+    # loadgen parity gate caught exactly this).
+    dm = ServingDaemon(ServePolicy(max_batch=8, max_wait_s=0.0))
+    b0, b1 = _board(rng, 16), _board(rng, 16)
+    dm.create_session("dup", b0)
+    dm.create_session("other", b1)
+    tks = [dm.submit_session("dup", 3), dm.submit_session("other", 3),
+           dm.submit_session("dup", 3), dm.submit_session("dup", 3)]
+    dm.pump(drain=True)
+    assert all(t.state == DONE for t in tks)
+    np.testing.assert_array_equal(dm.snapshot_session("dup"),
+                                  oracle_n(b0, 9))
+    np.testing.assert_array_equal(dm.snapshot_session("other"),
+                                  oracle_n(b1, 3))
+
+
 # ------------------------------------------------------------- crash matrix
 
 

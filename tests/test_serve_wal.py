@@ -547,3 +547,106 @@ def test_crash_matrix_loss_bounds(tmp_path, site, k, fsync):
         assert t.state == DONE
         np.testing.assert_array_equal(
             t.result, oracle_n(t.board, t.steps))
+
+
+# ------------------------------------------- membership crash matrix (PR 17)
+
+
+def _run_fleet_driver(tmp_path, mode, momp_chaos=None, n=6):
+    wal_dir = str(tmp_path / "fleet")
+    os.makedirs(wal_dir, exist_ok=True)
+    ackp = str(tmp_path / "acked.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MOMP_CHAOS", None)
+    if momp_chaos:
+        env["MOMP_CHAOS"] = momp_chaos
+    proc = subprocess.run(
+        [sys.executable, DRIVER, wal_dir, "every-record", ackp, str(n),
+         mode],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    return proc, wal_dir, ackp
+
+
+def _parse_acks(ackp):
+    created, steps, tickets = [], {}, 0
+    for line in open(ackp):
+        parts = line.split()
+        if parts[0] == "C":
+            created.append(parts[1])
+            steps.setdefault(parts[1], 0)
+        elif parts[0] == "S":
+            steps[parts[1]] += int(parts[2])
+        elif parts[0] == "T":
+            tickets += 1
+    return created, steps, tickets
+
+
+MEMBERSHIP_CELLS = [("rejoin", "post-rejoin"), ("drain", "mid-drain")]
+
+
+@pytest.mark.parametrize("mode,site", MEMBERSHIP_CELLS)
+def test_membership_crash_duplication_not_loss(tmp_path, mode, site):
+    """kill -9 inside the membership handshake — post-rejoin (dest
+    CREATE+STEP journaled, source EVICT not) and mid-drain (dest ADMITs
+    journaled, source re-homed SHED not). Both edges must duplicate,
+    never lose: every acked session appears in >=1 worker journal with
+    the acked step total — bit-equal create board and step count
+    wherever it appears in two — and the fleet-wide ticket count over
+    all journals is bounded by ``acked <= total <= acked + one
+    bucket``."""
+    proc, wal_dir, ackp = _run_fleet_driver(
+        tmp_path, mode, momp_chaos=f"crash={site}:1")
+    assert proc.returncode == chaos.CRASH_EXIT == 137, (
+        f"crash never fired: rc={proc.returncode} "
+        f"out={proc.stdout!r} err={proc.stderr!r}")
+    created, steps, acked_tickets = _parse_acks(ackp)
+    assert created, "driver acked nothing — the cell tested nothing"
+
+    replays = [wal.replay(os.path.join(wal_dir, f"worker{i}.wal"))
+               for i in range(3)]
+
+    # Sessions: zero acked loss, bit-exact wherever duplicated.
+    for sid in created:
+        copies = [rep.pool_sessions[sid] for rep in replays
+                  if sid in rep.pool_sessions]
+        assert copies, f"acked session {sid} lost across the crash"
+        for c in copies:
+            assert int(c["steps"]) == steps[sid], (sid, c["steps"])
+            np.testing.assert_array_equal(c["board"], copies[0]["board"])
+    if mode == "rejoin":
+        # The handshake crashed between its halves: at least one
+        # claimed session is journaled at BOTH workers.
+        dup = [sid for sid in created if sum(
+            sid in rep.pool_sessions for rep in replays) == 2]
+        assert dup, "post-rejoin kill left no duplicated session"
+
+    # Tickets: every journal's non-re-homed terminal + pending records,
+    # fleet-wide. Duplication (<= one whole bucket) allowed, loss not.
+    from mpi_and_open_mp_tpu.serve import SHED_REHOMED
+
+    total = 0
+    for rep in replays:
+        non_rehomed_shed = sum(
+            len(ids) for reason, ids in rep.shed_reasons.items()
+            if reason != SHED_REHOMED)
+        total += len(rep.pending) + len(rep.resolved_ids) \
+            + non_rehomed_shed
+    assert acked_tickets <= total <= acked_tickets + 6, (
+        mode, acked_tickets, total)
+
+
+@pytest.mark.parametrize("mode", ["rejoin", "drain"])
+def test_membership_clean_run_books_balance(tmp_path, mode):
+    """The unkilled control: REJOIN claims its sessions back / drain
+    migrates whole buckets + groups, and the fleet books balance across
+    the membership change."""
+    proc, _wal_dir, _ackp = _run_fleet_driver(tmp_path, mode)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["balanced"], line
+    if mode == "rejoin":
+        assert line["rejoins"] == 1 and line["claimed"] >= 3, line
+    else:
+        assert line["drains"] == 1, line
+        assert line["tickets_moved"] == 6, line
+        assert line["sessions_moved"] == 2, line
