@@ -151,6 +151,20 @@ WATCH_FIELDS = (
     "loadgen_goodput_rps",
     "loadgen_p999_latency_s",
     "rejoin_recovery_s",
+    # Ring-attention hop prefetch (PR 18): the prefetched ring's
+    # arithmetic rate (higher by the tflops rule) and the per-step K/V
+    # transfer time left EXPOSED after the double-slot schedule hides
+    # what it can (lower by the _s rule — this is the quantity the
+    # prefetch exists to shrink, the attention twin of
+    # sharded_exposed_s). ring_exposed_s growing back toward the
+    # rotation-priced transfer time means the issue-first schedule
+    # stopped hiding the wire; ring_prefetch_tflops falling means the
+    # deeper pipeline itself got slower. The engine-provenance side is
+    # covered separately: losing the ``:pf`` stamp suffix (the
+    # MOMP_RING_PREFETCH kill switch left on) is a downgrade within the
+    # pallas tier — see ``_prefetch_rank``.
+    "ring_prefetch_tflops",
+    "ring_exposed_s",
 )
 
 
@@ -180,7 +194,8 @@ def direction_for(field: str) -> str:
 PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
                      "attention_engine", "attention_hop_engine",
                      "attention_hop_engine_bwd", "sparse_engine",
-                     "sharded_halo", "sparse_sharded_engine")
+                     "sharded_halo", "sparse_sharded_engine",
+                     "ring_hop_engine", "ring_hop_engine_bwd")
 
 #: ``workload`` joined in PR 13: a heat line and a life line of the same
 #: shape are different rules — they must never share a baseline group
@@ -229,6 +244,23 @@ def engine_rank(stamp) -> int:
     if s.startswith(("bitfused", "vmem", "grid", "fused", "frame")):
         return 2
     return 1 if s else 0
+
+
+def _prefetch_rank(stamp) -> int:
+    """Within-tier schedule sub-rank: the ring hop stamps carry a
+    trailing ``:pf`` when the double-slot K/V prefetch is engaged
+    (``context._ring_prefetch_on``). Losing it at the same engine tier
+    — the MOMP_RING_PREFETCH kill switch left on after a chaos drill,
+    exactly like MOMP_HALO_OVERLAP's failure shape — is a provenance
+    downgrade even when the rates sit inside the noise floor."""
+    return 1 if ":pf" in str(stamp or "") else 0
+
+
+def _provenance_key(stamp):
+    """Sort/compare key for provenance stamps: engine tier first, the
+    schedule sub-rank as tiebreak (a tier upgrade always wins; a same-
+    tier prefetch loss still counts as a downgrade)."""
+    return (engine_rank(stamp), _prefetch_rank(stamp))
 
 
 def _usable(entry: dict) -> bool:
@@ -327,8 +359,8 @@ def evaluate(entries: list[dict], *, n: int = 5, noise: float = 0.1,
         if new is None or not base:
             continue
         checked.append(field)
-        best = max(base, key=engine_rank)
-        if engine_rank(new) < engine_rank(best):
+        best = max(base, key=_provenance_key)
+        if _provenance_key(new) < _provenance_key(best):
             downgrades.append({"field": field, "new": new,
                                "baseline_best": best})
 

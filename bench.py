@@ -1043,6 +1043,294 @@ def _sharded_ab_phase(args, workload: str) -> dict:
                     exposed_s=round(exposed_s, 8),
                     efficiency=round(efficiency, 4),
                     vs_sequential=fields["vs_sequential"])
+
+    # PARTITIONED-BOUNDARY sweep (PR 18): the same spec through every
+    # layout the transport supports — row, col (x-mirror), cart (two-
+    # phase corners) — with the boundary split one step per sub-
+    # exchange (fuse=2, boundary=1, the ``:pb1`` stamps). Each leg is
+    # parity-gated against the 8-step oracle and required bit-identical
+    # to its own forced-sequential coupled twin: partitioning moves
+    # signalling, never arithmetic. The row leg also gets a chain-
+    # differenced rate against the coupled fuse=2 schedule so the split
+    # is priced, not just proven.
+    fuse, bs = 2, 1
+    engines: dict = {}
+    boundary_ok = True
+    for lay in ("row", "col", "cart"):
+        bmesh = (mesh if lay == "row"
+                 else mesh_lib.make_mesh_1d(axis=mesh_lib.AXIS_X)
+                 if lay == "col" else mesh_lib.make_mesh_2d())
+        bpy, bpx = stencil_engine.mesh_axes_for(lay, bmesh)
+        if edge % bpy or edge % bpx:
+            engines[lay] = f"skipped: {edge} % ({bpy},{bpx})"
+            continue
+        got = np.asarray(stencil_engine.run_sharded(
+            spec, board, 8, mesh=bmesh, layout=lay, fuse_steps=fuse,
+            boundary_steps=bs))
+        engines[lay] = stencil_engine.run_sharded.last_plan.engine
+        seq = np.asarray(stencil_engine.run_sharded(
+            spec, board, 8, mesh=bmesh, layout=lay, fuse_steps=fuse,
+            overlap=False))
+        if not (np.array_equal(got, seq) and stencils.parity_ok(
+                spec, got, stencils.oracle_run(spec, board, 8))):
+            boundary_ok = False
+            engines[lay] += " PARITY-FAIL"
+    fields.update({
+        "sharded_boundary_fuse": fuse,
+        "sharded_boundary_depth": bs,
+        "sharded_boundary_engines": engines,
+        "sharded_boundary_parity": boundary_ok,
+    })
+    if not boundary_ok:
+        fields["sharded_ab_error"] = (
+            "partitioned-boundary sweep diverged: "
+            + json.dumps(engines))
+        return fields
+
+    run_pb, _ = stencil_engine.make_sharded_runner(
+        spec, mesh, "row", (edge, edge), fuse_steps=fuse,
+        boundary_steps=bs)
+    run_cpl, _ = stencil_engine.make_sharded_runner(
+        spec, mesh, "row", (edge, edge), fuse_steps=fuse)
+    pb_step, pb_final, _ = per_step(run_pb)
+    cpl_step, cpl_final, _ = per_step(run_cpl)
+    fields.update({
+        "sharded_boundary_cups": round(cells / pb_step, 1),
+        "sharded_boundary_vs_coupled": round(cpl_step / pb_step, 3),
+    })
+    if not np.array_equal(pb_final, cpl_final):
+        fields["sharded_ab_error"] = (
+            "partitioned-boundary full run diverged from the coupled "
+            "schedule")
+    return fields
+
+
+def _ring_ab_phase(args) -> dict:
+    """``_ring_ab_measure`` behind a hop-span opt-out. With a trace sink
+    live, ``ring_attention`` reroutes to the hop-by-hop telemetry
+    dispatch (``trace.hop_spans_active``): p-1 host-anchored hops — a
+    host RTT per hop that would swamp the A/B, and a forward with no
+    grad path (the per-hop re-plan differentiates through a bare
+    ``pallas_call``, which JVP rejects). The A/B must price the
+    production fused dispatch, so the phase pins ``MOMP_TRACE_HOPS=0``
+    for its duration; whole-call spans and the ``ring.ab`` event still
+    land in the trace."""
+    prev = os.environ.get("MOMP_TRACE_HOPS")
+    os.environ["MOMP_TRACE_HOPS"] = "0"
+    try:
+        return _ring_ab_measure(args)
+    finally:
+        if prev is None:
+            os.environ.pop("MOMP_TRACE_HOPS", None)
+        else:
+            os.environ["MOMP_TRACE_HOPS"] = prev
+
+
+def _ring_ab_measure(args) -> dict:
+    """The RING-ATTENTION HOP-PREFETCH A/B (``--ring-ab R``): R causal
+    ring-attention trips over the full device mesh with the double-slot
+    K/V hop prefetch engaged (``context._RING_PREFETCH``, ``:pf``
+    stamps) versus the single-slot schedule it deepens, on the SAME
+    operands. Honesty discipline mirrors ``_sharded_ab_phase``: the
+    prefetch leg is dense-oracle parity-gated first, the single-slot
+    leg must match it bit-exactly (same folds in the same order — only
+    the rotation issue points move), gradients are cross-checked the
+    same way, and both rates are chain-differenced (R and 2R calls from
+    warm executables, min-of-2). The exposed-vs-hidden accounting rides
+    a rotation-only microbench: ``ring_transfer_s`` prices the p-1 K/V
+    ppermutes of one trip with no kernel behind them, the single-slot
+    baseline is charged the whole transfer (it is the baseline the
+    hiding is measured against, exactly like the sharded A/B's forced-
+    sequential leg), and ``ring_exposed_s`` is the remainder the
+    prefetch failed to hide. The ``ring_hop_engine``/``_bwd`` stamps
+    are what the prefetch leg actually dispatched (``…:pf``, or the
+    bare kernel stamp when ``MOMP_RING_PREFETCH=0`` downgraded it —
+    the sentinel fails that rerun as a provenance downgrade)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_and_open_mp_tpu.obs import trace as obs_trace
+    from mpi_and_open_mp_tpu.parallel import context, mesh as mesh_lib
+    from mpi_and_open_mp_tpu.parallel.halo import ring_perm
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    n_calls = args.ring_ab
+    p = jax.device_count()
+    fields = {"ring_ab_calls": n_calls, "ring_ab_devices": p}
+    if p < 3:
+        fields["ring_ab_error"] = (
+            "needs >= 3 devices (a 2-device ring has a single transfer "
+            "— nothing to pipeline deeper); CI runs it under the "
+            "8-virtual-device CPU mesh")
+        return fields
+
+    # 128-token shards at an MXU-width head dim: the one hop shape the
+    # interpret-mode kernel takes (block == n_local), so the SAME phase
+    # exercises the real hopflash prefetch on the CPU CI mesh
+    # (MOMP_PALLAS_INTERPRET=1) and on chip.
+    h, d, nl = 4, 128, 128
+    n = nl * p
+    fields["ring_ab_shape"] = [h, n, d]
+    axis = context.AXIS_SP
+    mesh = mesh_lib.make_mesh_1d(axis=axis)
+
+    stamp = context.ring_hop_engine_for(
+        jax.ShapeDtypeStruct((h, n, d), jnp.float32),
+        jax.ShapeDtypeStruct((h, n, d), jnp.float32),
+        jax.ShapeDtypeStruct((h, n, d), jnp.float32), p=p, causal=True)
+    fields["ring_hop_engine"] = stamp
+    fields["ring_hop_engine_bwd"] = context.ring_hop_bwd_engine_for(
+        jax.ShapeDtypeStruct((h, n, d), jnp.float32),
+        jax.ShapeDtypeStruct((h, n, d), jnp.float32),
+        jax.ShapeDtypeStruct((h, n, d), jnp.float32), p=p, causal=True)
+    if not stamp.endswith(":pf"):
+        fields["ring_ab_error"] = (
+            f"hop prefetch not engaged (stamp {stamp}): the A/B needs "
+            "the Pallas hop engine (TPU backend, or "
+            "MOMP_PALLAS_INTERPRET=1 with 128-token shards) and "
+            "MOMP_RING_PREFETCH unset")
+        return fields
+
+    rng = np.random.default_rng(48)
+    q, k, v = (jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
+               for _ in range(3))
+
+    def ring(q_, k_, v_):
+        return context.ring_attention(q_, k_, v_, mesh=mesh, axis=axis,
+                                      causal=True)
+
+    @jax.jit
+    def chain(q_, k_, v_, r):
+        # Output feeds the next call's queries so the chain can't be
+        # elided; K/V are re-rotated around the ring every link.
+        return lax.fori_loop(0, r, lambda _, c: ring(c, k_, v_), q_)
+
+    def grads(q_, k_, v_):
+        def loss(a, b, c):
+            return (ring(a, b, c).astype(jnp.float32) ** 2).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+
+    def timed(call):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            anchor_sync(call(), fetch_all=True)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def leg():
+        fwd = np.asarray(ring(q, k, v))
+        g = [np.asarray(x) for x in grads(q, k, v)]
+        anchor_sync(chain(q, k, v, jnp.int32(n_calls)), fetch_all=True)
+        anchor_sync(chain(q, k, v, jnp.int32(2 * n_calls)),
+                    fetch_all=True)
+        t1 = timed(lambda: chain(q, k, v, jnp.int32(n_calls)))
+        t2 = timed(lambda: chain(q, k, v, jnp.int32(2 * n_calls)))
+        per_call = (t2 - t1) / n_calls if t2 > t1 else t1 / n_calls
+        return fwd, g, per_call, t2 > t1
+
+    # Parity gate BEFORE any recorded timing: the prefetch leg against
+    # the dense oracle, then the single-slot leg bit-identical to it
+    # (forward) and matching on gradients. The kill switch is a
+    # trace-time flag, so each flip clears the jit caches (same
+    # discipline as the MOMP_RING_HOP tests).
+    pf_fwd, pf_g, pf_call, pf_diff = leg()
+    want = np.asarray(context.attention_reference(q, k, v, causal=True))
+    if not np.allclose(pf_fwd, want, rtol=1e-4, atol=1e-4):
+        fields["ring_ab_error"] = "prefetch leg failed oracle parity"
+        return fields
+    prev_pf = context._RING_PREFETCH
+    try:
+        context._RING_PREFETCH = False
+        jax.clear_caches()
+        fields["ring_nopf_engine"] = context.ring_hop_engine_for(
+            jax.ShapeDtypeStruct((h, n, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, n, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, n, d), jnp.float32), p=p,
+            causal=True)
+        nopf_fwd, nopf_g, nopf_call, nopf_diff = leg()
+    finally:
+        context._RING_PREFETCH = prev_pf
+        jax.clear_caches()
+    parity = np.array_equal(pf_fwd, nopf_fwd)
+    grad_parity = all(
+        np.allclose(a, b, rtol=1e-6, atol=1e-6)
+        for a, b in zip(pf_g, nopf_g))
+    flops = 2 * h * n * n * d  # QK^T + PV, causal half
+    fields.update({
+        "ring_ab_parity": parity,
+        "ring_ab_grad_parity": grad_parity,
+        "ring_prefetch_sec": round(pf_call, 6),
+        "ring_prefetch_tflops": round(flops / pf_call / 1e12, 4),
+        "ring_nopf_sec": round(nopf_call, 6),
+        "ring_nopf_tflops": round(flops / nopf_call / 1e12, 4),
+        "ring_vs_nopf": round(nopf_call / pf_call, 3),
+        "ring_ab_is_differenced": pf_diff and nopf_diff,
+    })
+    if not parity:
+        fields["ring_ab_error"] = (
+            "prefetch forward diverged from the single-slot schedule")
+        return fields
+    if not grad_parity:
+        fields["ring_ab_error"] = (
+            "prefetch gradients diverged from the single-slot schedule")
+        return fields
+
+    # Rotation-only microbench: the p-1 K/V ppermutes of one ring trip
+    # with no kernel behind them, same chained-differencing bracket.
+    # The tuple carry keeps the collectives live in the loop.
+    spec = context._seq_spec(axis)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    kd = jax.device_put(k, sharding)
+    vd = jax.device_put(v, sharding)
+
+    def rot(kb, vb):
+        perm = ring_perm(p, 1)
+        return (lax.ppermute(kb, axis, perm),
+                lax.ppermute(vb, axis, perm))
+
+    smapped = mesh_lib.shard_map(rot, mesh=mesh, in_specs=(spec, spec),
+                                 out_specs=(spec, spec), check_vma=False)
+
+    @jax.jit
+    def rot_n(kb, vb, r):
+        return lax.fori_loop(0, r, lambda _, c: smapped(*c), (kb, vb))
+
+    def rot_timed(r):
+        t0 = time.perf_counter()
+        anchor_sync(rot_n(kd, vd, jnp.int32(r)), fetch_all=True)
+        return time.perf_counter() - t0
+
+    hops = (p - 1) * n_calls
+    anchor_sync(rot_n(kd, vd, jnp.int32(hops)), fetch_all=True)
+    x1 = min(rot_timed(hops) for _ in range(2))
+    x2 = min(rot_timed(2 * hops) for _ in range(2))
+    per_rot = (x2 - x1) / hops if x2 > x1 else x1 / hops
+    transfer_s = per_rot * (p - 1)
+
+    # hidden = the seconds the deeper pipeline actually saved per trip;
+    # exposed = the transfer remainder still on the critical path
+    # (clamped to the transfer itself). The single-slot baseline is
+    # charged the full transfer by the same accounting the sharded A/B
+    # charges its forced-sequential leg.
+    hidden_s = max(0.0, nopf_call - pf_call)
+    exposed_s = min(transfer_s, max(0.0, transfer_s - hidden_s))
+    efficiency = (min(1.0, hidden_s / transfer_s)
+                  if transfer_s > 0 else 0.0)
+    fields.update({
+        "ring_transfer_s": round(transfer_s, 8),
+        "ring_exposed_s": round(exposed_s, 8),
+        "ring_exposed_nopf_s": round(transfer_s, 8),
+        "ring_prefetch_efficiency": round(efficiency, 4),
+    })
+    obs_trace.event("ring.ab", devices=p, shape=[h, n, d],
+                    engine=stamp,
+                    transfer_s=round(transfer_s, 8),
+                    exposed_s=round(exposed_s, 8),
+                    efficiency=round(efficiency, 4),
+                    vs_nopf=fields["ring_vs_nopf"])
     return fields
 
 
@@ -1315,6 +1603,19 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
                               "sharded_ab_error":
                               f"{type(e).__name__}: {e}"[:200]}
 
+    # The ring A/B is workload-generic too: it prices the attention
+    # hop-prefetch schedule, not the stencil.
+    ring_ab = {}
+    if args.ring_ab:
+        state["phase"] = "ring_ab"
+        with obs_trace.span("bench.phase", phase="ring_ab"):
+            try:
+                ring_ab = _ring_ab_phase(args)
+            except Exception as e:
+                ring_ab = {"ring_ab_calls": args.ring_ab,
+                           "ring_ab_error":
+                           f"{type(e).__name__}: {e}"[:200]}
+
     state["phase"] = "measure"
 
     def timed(n, reps=3):
@@ -1363,6 +1664,7 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
         "plan_source": "heuristic",
         **tuned,
         **sharded_ab,
+        **ring_ab,
         **metrics_fields,
         **backend_note,
     }
@@ -1414,6 +1716,21 @@ def main(argv=None) -> int:
                     "device CPU mesh; MOMP_HALO_OVERLAP=0 downgrades the "
                     "sharded_halo stamp to seq:*, which the sentinel "
                     "fails as a provenance downgrade)")
+    ap.add_argument("--ring-ab", type=int, default=0, metavar="R",
+                    help="also run the RING-ATTENTION HOP-PREFETCH A/B "
+                    "(any workload): R causal ring-attention trips over "
+                    "the full device mesh, double-slot K/V hop prefetch "
+                    "(:pf) vs the single-slot schedule on the same "
+                    "operands, prefetch leg oracle-parity-gated, both "
+                    "legs chain-differenced and required bit-identical "
+                    "forward (gradients cross-checked), reporting "
+                    "ring_prefetch_tflops / ring_nopf_tflops / "
+                    "ring_vs_nopf plus the rotation-only transfer-vs-"
+                    "exposed accounting on the JSON line (needs >= 3 "
+                    "devices — CI uses the 8-virtual-device CPU mesh "
+                    "with MOMP_PALLAS_INTERPRET=1; MOMP_RING_PREFETCH=0 "
+                    "drops the :pf stamp, which the sentinel fails as a "
+                    "provenance downgrade)")
     ap.add_argument("--sparse-sharded-ab", type=int, default=0,
                     metavar="K",
                     help="also run the SPARSE x SHARDED A/B (life "
@@ -1589,6 +1906,9 @@ def main(argv=None) -> int:
                  "chained-differencing bracket")
     if args.sharded_ab and args.sharded_ab < 16:
         ap.error("--sharded-ab needs >= 16 steps for the "
+                 "chained-differencing bracket")
+    if args.ring_ab and args.ring_ab < 16:
+        ap.error("--ring-ab needs >= 16 calls for the "
                  "chained-differencing bracket")
     if args.sparse_ab or args.sparse_sharded_ab:
         if args.sparse_ab and args.sparse_ab < 16:
@@ -1953,6 +2273,20 @@ def _bench(args, state) -> int:
                               "sharded_ab_error":
                               f"{type(e).__name__}: {e}"[:200]}
 
+    # Ring-attention hop-prefetch A/B (opt-in via --ring-ab R): the
+    # double-slot K/V rotation schedule vs the single-slot one it
+    # deepens. Same failure contract as the other opt-in phases.
+    ring_ab = {}
+    if args.ring_ab:
+        state["phase"] = "ring_ab"
+        with obs_trace.span("bench.phase", phase="ring_ab"):
+            try:
+                ring_ab = _ring_ab_phase(args)
+            except Exception as e:
+                ring_ab = {"ring_ab_calls": args.ring_ab,
+                           "ring_ab_error":
+                           f"{type(e).__name__}: {e}"[:200]}
+
     # Sparse x sharded A/B (opt-in via --sparse-sharded-ab K): the
     # composition of the sparse active-tile mask with the sharded halo
     # exchange. Same failure contract as the other opt-in phases.
@@ -2255,6 +2589,7 @@ def _bench(args, state) -> int:
         **served,
         **sparse,
         **sharded_ab,
+        **ring_ab,
         **sparse_sharded,
         **sharded,
         **prof_fields,

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# r08 queued increment (ISSUE 18, DESIGN.md §20): partitioned halo
+# transport everywhere + the ring-attention hop prefetch on the real
+# chip. Three legs, one chip process each, sequential:
+#   1) the sharded A/B with MOMP_HALO_RDMA=1 — on a multi-chip ring the
+#      col/cart Pallas async-remote-copy rungs (x-mirror, two-phase
+#      corner exchange) and the partitioned-boundary sweep (:pb1
+#      stamps) all run inside the phase; on the 1-chip bench topology
+#      the phase reports sharded_ab_error (needs >= 2 devices) and the
+#      line still lands — honest provenance either way.
+#   2) the split-depth tune: interior fuse depth x boundary depth
+#      enumerated independently (MOMP_TUNE_FUSE_DEPTHS=1,2,4,8 — the
+#      deep rungs only the chip's exposed transfer can justify), the
+#      coupled-depth heuristic always in the race, winners persisted to
+#      the plan store for zero-retrace reuse.
+#   3) the ring-attention hop-prefetch A/B: double-slot K/V rotation
+#      (:pf) vs the single-slot schedule, parity-gated, chain-
+#      differenced, exposed-transfer accounting from the rotation-only
+#      microbench. Needs >= 3 devices; on one chip the phase reports
+#      ring_ab_error and the line still lands.
+# Every line lands in MOMP_LEDGER (exported by tpu_queue_loop.sh);
+# losing overlap:*/:pb/:pf provenance later flags at the queue loop's
+# sentinel gate. Exits nonzero on failure so the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+MOMP_HALO_RDMA=1 python bench.py --board 500 --steps 500 \
+    --sharded-ab 64 --sharded-board 512
+
+MOMP_HALO_RDMA=1 MOMP_TUNE_FUSE_DEPTHS=1,2,4,8 python bench.py \
+    --board 500 --steps 500 --autotune 32 --tune-board 512 \
+    --plans "${MOMP_TUNE_PLANS:-results/plans}"
+
+python bench.py --board 500 --steps 500 --ring-ab 64

@@ -1127,23 +1127,49 @@ _RING_HOP_BWD = os.environ.get("MOMP_RING_HOP_BWD", "1") != "0"
 # (the pre-decomposition behaviour).
 _RING_ZZ = os.environ.get("MOMP_RING_ZZ", "1") != "0"
 
+# Hop prefetch: issue hop i+1's K/V rotation before hop i's flash
+# kernel launches. The hopflash loops always had ONE rotation in
+# flight (issued at the top of each hop, consumed at the top of the
+# next); the prefetched schedule carries TWO K/V slots — the block
+# being folded and the block in flight — so every rotation gets two
+# kernel launches of hiding slack instead of one. Same p-1 rotations,
+# same folds in the same order (parity is bit-exact); only the issue
+# points move earlier. Needs p >= 3 (with fewer devices there is no
+# second transfer to deepen the pipeline with) and applies to the
+# hopflash forward, its causal-zigzag decomposition, and the
+# travelling-dk/dv backward's K/V trip (the dk/dv accumulator
+# rotations cannot prefetch — each carries the hop's own
+# contribution). MOMP_RING_PREFETCH=0 is the kill switch back to the
+# single-slot schedule; the guarded recovery path pins it off with
+# the hop kernels (the recovered trace is the plain jnp fold).
+_RING_PREFETCH = os.environ.get("MOMP_RING_PREFETCH", "1") != "0"
+
+
+def _ring_prefetch_on(p: int) -> bool:
+    """Whether the hopflash loops run the double-slot prefetched
+    schedule for a ``p``-device ring (gate + eligibility: a 2-device
+    ring has a single transfer — nothing to pipeline deeper)."""
+    return _RING_PREFETCH and p > 2
+
 
 @contextlib.contextmanager
 def _ring_hop_pinned(value: bool):
     """Pin the ring-hop engine gates for one dispatch: the guarded
     recovery path in :func:`ring_attention` re-dispatches a poisoned
     fold on the jnp fold oracle by tracing with the hop kernels pinned
-    off — BOTH directions, so the recovered trace is the full jnp fold
-    (paired with a distinct jit-cache key — the flags are read at
-    trace time, not part of the cache key)."""
-    global _RING_HOP, _RING_HOP_BWD
-    prev = (_RING_HOP, _RING_HOP_BWD)
+    off — BOTH directions, and the hop prefetch with them, so the
+    recovered trace is the full single-slot jnp fold (paired with a
+    distinct jit-cache key — the flags are read at trace time, not
+    part of the cache key)."""
+    global _RING_HOP, _RING_HOP_BWD, _RING_PREFETCH
+    prev = (_RING_HOP, _RING_HOP_BWD, _RING_PREFETCH)
     _RING_HOP = value
     _RING_HOP_BWD = value
+    _RING_PREFETCH = value
     try:
         yield
     finally:
-        _RING_HOP, _RING_HOP_BWD = prev
+        _RING_HOP, _RING_HOP_BWD, _RING_PREFETCH = prev
 
 
 def _ring_hop_plan(q, k, v, causal: bool, layout: str):
@@ -1247,7 +1273,14 @@ def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
     causal mask is the standard triangle in local coordinates, i.e. the
     kernel's static ``causal`` flag; every later unskipped hop
     (``src < idx``) is fully unmasked. Returns ``(o, L)`` with ``L`` in
-    the folded GQA layout ``_ring_flash_bwd`` consumes."""
+    the folded GQA layout ``_ring_flash_bwd`` consumes.
+
+    With :func:`_ring_prefetch_on` the loop runs the double-slot
+    prefetched schedule (see the ``_RING_PREFETCH`` note): hop 1 AND
+    hop 2 rotations leave before the diagonal kernel, and each loop
+    iteration issues hop ``j+2``'s rotation from the arriving buffer
+    before folding hop ``j`` — two folds of hiding slack per transfer,
+    identical fold order and rotation count."""
     idx = lax.axis_index(axis) if causal else 0
     hkv = k.shape[0]
     g = q.shape[0] // hkv
@@ -1267,6 +1300,12 @@ def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
     # (the jnp fold's double-buffering, same latency-hiding pairing).
     k1 = lax.ppermute(k, axis, perm)
     v1 = lax.ppermute(v, axis, perm)
+    prefetch = _ring_prefetch_on(p)
+    if prefetch:
+        # Hop 2's rotation leaves before the diagonal kernel too — from
+        # here on two K/V transfers are in flight at every kernel launch.
+        k2 = lax.ppermute(k1, axis, perm)
+        v2 = lax.ppermute(v1, axis, perm)
     state = _hop_flash_block(q, k0, v0, causal, blk, groups)
 
     def fold(j, state, kb, vb):
@@ -1288,15 +1327,32 @@ def _ring_forward_hopflash(axis: str, causal: bool, p: int, q, k, v, plan):
     if _poison is not None:
         fold = _chaos.poisoned_fold(fold, _poison)
 
-    def hop(j, carry):
-        state, kb, vb = carry
-        kb_next = lax.ppermute(kb, axis, perm)
-        vb_next = lax.ppermute(vb, axis, perm)
-        state = fold(j, state, kb, vb)
-        return state, kb_next, vb_next
+    if prefetch:
 
-    state, kb, vb = lax.fori_loop(1, p - 1, hop, (state, k1, v1))
-    o, L = fold(p - 1, state, kb, vb)
+        def hop(j, carry):
+            state, kb, vb, kb_in, vb_in = carry
+            kb_next = lax.ppermute(kb_in, axis, perm)
+            vb_next = lax.ppermute(vb_in, axis, perm)
+            state = fold(j, state, kb, vb)
+            return state, kb_in, vb_in, kb_next, vb_next
+
+        # Loop issues hops 3..p-1 (two ahead of consumption); the last
+        # two arrived blocks fold outside it — same p-1 rotations total.
+        state, kb, vb, kb_in, vb_in = lax.fori_loop(
+            1, p - 2, hop, (state, k1, v1, k2, v2))
+        state = fold(p - 2, state, kb, vb)
+        o, L = fold(p - 1, state, kb_in, vb_in)
+    else:
+
+        def hop(j, carry):
+            state, kb, vb = carry
+            kb_next = lax.ppermute(kb, axis, perm)
+            vb_next = lax.ppermute(vb, axis, perm)
+            state = fold(j, state, kb, vb)
+            return state, kb_next, vb_next
+
+        state, kb, vb = lax.fori_loop(1, p - 1, hop, (state, k1, v1))
+        o, L = fold(p - 1, state, kb, vb)
     # The kernel emits per-q-head rows; the ring backward consumes the
     # folded GQA layout (row r <-> position r // g, group r % g).
     return o.astype(q.dtype), _fold_groups(L, hkv, g)
@@ -1340,6 +1396,13 @@ def _ring_forward_hopflash_zz(axis: str, p: int, q, k, v, plan):
 
     k1 = lax.ppermute(k, axis, perm)
     v1 = lax.ppermute(v, axis, perm)
+    prefetch = _ring_prefetch_on(p)
+    if prefetch:
+        # Double-slot prefetch, exactly as the contiguous forward: hop
+        # 2's rotation also leaves before the resident half-chunk
+        # kernels run.
+        k2 = lax.ppermute(k1, axis, perm)
+        v2 = lax.ppermute(v1, axis, perm)
 
     k_lo, k_hi = k0[:, :half], k0[:, half:]
     v_lo, v_hi = v0[:, :half], v0[:, half:]
@@ -1372,15 +1435,31 @@ def _ring_forward_hopflash_zz(axis: str, p: int, q, k, v, plan):
     if _poison is not None:
         fold = _chaos.poisoned_fold(fold, _poison)
 
-    def hop(j, carry):
-        state, kb, vb = carry
-        kb_next = lax.ppermute(kb, axis, perm)
-        vb_next = lax.ppermute(vb, axis, perm)
-        state = fold(j, state, kb, vb)
-        return state, kb_next, vb_next
+    if prefetch:
 
-    state, kb, vb = lax.fori_loop(1, p - 1, hop, ((s_lo, s_hi), k1, v1))
-    s_lo, s_hi = fold(p - 1, state, kb, vb)
+        def hop(j, carry):
+            state, kb, vb, kb_in, vb_in = carry
+            kb_next = lax.ppermute(kb_in, axis, perm)
+            vb_next = lax.ppermute(vb_in, axis, perm)
+            state = fold(j, state, kb, vb)
+            return state, kb_in, vb_in, kb_next, vb_next
+
+        state, kb, vb, kb_in, vb_in = lax.fori_loop(
+            1, p - 2, hop, ((s_lo, s_hi), k1, v1, k2, v2))
+        state = fold(p - 2, state, kb, vb)
+        s_lo, s_hi = fold(p - 1, state, kb_in, vb_in)
+    else:
+
+        def hop(j, carry):
+            state, kb, vb = carry
+            kb_next = lax.ppermute(kb, axis, perm)
+            vb_next = lax.ppermute(vb, axis, perm)
+            state = fold(j, state, kb, vb)
+            return state, kb_next, vb_next
+
+        state, kb, vb = lax.fori_loop(
+            1, p - 1, hop, ((s_lo, s_hi), k1, v1))
+        s_lo, s_hi = fold(p - 1, state, kb, vb)
     o = jnp.concatenate([s_lo[0], s_hi[0]], axis=1).astype(q.dtype)
     L = jnp.concatenate([s_lo[1], s_hi[1]], axis=1)
     return o, _fold_groups(L, hkv, g)
@@ -1439,9 +1518,16 @@ def _ring_backward_hopflash(axis: str, causal: bool, p: int, res, do,
                 jnp.zeros((hkv, nl, d), f32))
 
     # Hop 0: resident diagonal block, double-buffered like the forward
-    # (first rotation issued before the kernel launches).
+    # (first rotation issued before the kernel launches; under prefetch
+    # the second K/V rotation leaves before them too — the dk/dv
+    # accumulator rotations CANNOT prefetch, each carries the hop's own
+    # contribution, so only the K/V trip deepens).
     k1 = lax.ppermute(k, axis, perm)
     v1 = lax.ppermute(v, axis, perm)
+    prefetch = _ring_prefetch_on(p)
+    if prefetch:
+        k2 = lax.ppermute(k1, axis, perm)
+        v2 = lax.ppermute(v1, axis, perm)
     dq0, dk0, dv0 = kernel_contrib(k, v, True)
     dkb = lax.ppermute(dk0, axis, perm)
     dvb = lax.ppermute(dv0, axis, perm)
@@ -1458,24 +1544,51 @@ def _ring_backward_hopflash(axis: str, causal: bool, p: int, res, do,
             src < idx, lambda _: kernel_contrib(kb, vb, False), zero3,
             None)
 
-    def hop(j, carry):
-        dq, kb, vb, dkb, dvb = carry
-        kb_next = lax.ppermute(kb, axis, perm)
-        vb_next = lax.ppermute(vb, axis, perm)
-        dqj, dkj, dvj = contribute(j, kb, vb)
+    if prefetch:
+
+        def hop(j, carry):
+            dq, kb, vb, kb_in, vb_in, dkb, dvb = carry
+            kb_next = lax.ppermute(kb_in, axis, perm)
+            vb_next = lax.ppermute(vb_in, axis, perm)
+            dqj, dkj, dvj = contribute(j, kb, vb)
+            dkb = lax.ppermute(dkb + dkj, axis, perm)
+            dvb = lax.ppermute(dvb + dvj, axis, perm)
+            return dq + dqj, kb_in, vb_in, kb_next, vb_next, dkb, dvb
+
+        # Loop issues K/V hops 3..p-1 two ahead of consumption; the
+        # last two arrived blocks contribute outside it. Accumulator
+        # rotations: hop-0 peel + p-3 loop + the two tail ones = p,
+        # same count as the single-slot schedule.
+        dq, kb, vb, kb_in, vb_in, dkb, dvb = lax.fori_loop(
+            1, p - 2, hop, (dq0, k1, v1, k2, v2, dkb, dvb))
+        dqj, dkj, dvj = contribute(p - 2, kb, vb)
+        dq = dq + dqj
         dkb = lax.ppermute(dkb + dkj, axis, perm)
         dvb = lax.ppermute(dvb + dvj, axis, perm)
-        return dq + dqj, kb_next, vb_next, dkb, dvb
+        dqj, dkj, dvj = contribute(p - 1, kb_in, vb_in)
+        dq = dq + dqj
+        dk = lax.ppermute(dkb + dkj, axis, perm)
+        dv = lax.ppermute(dvb + dvj, axis, perm)
+    else:
 
-    dq, kb, vb, dkb, dvb = lax.fori_loop(
-        1, p - 1, hop, (dq0, k1, v1, dkb, dvb))
-    # Last block, then the p-th accumulator rotation lands every
-    # (dk, dv) back on its home shard (hop-0 peel + p-2 loop rotations
-    # + this one = p, same count as the jnp path).
-    dqj, dkj, dvj = contribute(p - 1, kb, vb)
-    dq = dq + dqj
-    dk = lax.ppermute(dkb + dkj, axis, perm)
-    dv = lax.ppermute(dvb + dvj, axis, perm)
+        def hop(j, carry):
+            dq, kb, vb, dkb, dvb = carry
+            kb_next = lax.ppermute(kb, axis, perm)
+            vb_next = lax.ppermute(vb, axis, perm)
+            dqj, dkj, dvj = contribute(j, kb, vb)
+            dkb = lax.ppermute(dkb + dkj, axis, perm)
+            dvb = lax.ppermute(dvb + dvj, axis, perm)
+            return dq + dqj, kb_next, vb_next, dkb, dvb
+
+        dq, kb, vb, dkb, dvb = lax.fori_loop(
+            1, p - 1, hop, (dq0, k1, v1, dkb, dvb))
+        # Last block, then the p-th accumulator rotation lands every
+        # (dk, dv) back on its home shard (hop-0 peel + p-2 loop
+        # rotations + this one = p, same count as the jnp path).
+        dqj, dkj, dvj = contribute(p - 1, kb, vb)
+        dq = dq + dqj
+        dk = lax.ppermute(dkb + dkj, axis, perm)
+        dv = lax.ppermute(dvb + dvj, axis, perm)
     dq = _unfold_groups(dq, hkv, g).astype(q.dtype)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -1642,7 +1755,10 @@ def ring_hop_engine_for(q, k, v, *, p: int | None = None,
     publishing ring timings must stamp artifacts with this, exactly as
     single-device recorders stamp :func:`flash_engine_for`. 4D
     ``(B, heads, seq, d)`` operands stamp the folded-batch engine with
-    a ``:b{B}`` suffix (see :func:`_fold_batch`)."""
+    a ``:b{B}`` suffix (see :func:`_fold_batch`). A trailing ``:pf``
+    marks the double-slot hop-prefetch schedule (``_RING_PREFETCH``
+    on, ring size > 2): hop ``i+1``'s K/V rotation is issued before
+    hop ``i``'s kernel launches."""
     if len(q.shape) == 4:
         probe_q, probe_k, probe_v = _fold_batch_probes(q, k, v)
         return ring_hop_engine_for(
@@ -1663,6 +1779,8 @@ def ring_hop_engine_for(q, k, v, *, p: int | None = None,
     stamp = _plan_stamp(plan)
     if causal and layout == "zigzag":
         stamp += ":zz"
+    if _ring_prefetch_on(p):
+        stamp += ":pf"
     return stamp
 
 
@@ -1680,7 +1798,9 @@ def ring_hop_bwd_engine_for(q, k, v, *, p: int | None = None,
     engine (whose stamp already carries the kernel backward edge when
     it differs). Recorders publishing ring GRADIENT timings must stamp
     artifacts with this, alongside :func:`ring_hop_engine_for`. 4D
-    operands fold and stamp ``:b{B}`` exactly as the forward twin."""
+    operands fold and stamp ``:b{B}`` exactly as the forward twin; a
+    trailing ``:pf`` marks the prefetched K/V trip exactly as the
+    forward's (the dk/dv accumulator rotations never prefetch)."""
     if len(q.shape) == 4:
         probe_q, probe_k, probe_v = _fold_batch_probes(q, k, v)
         return ring_hop_bwd_engine_for(
@@ -1702,6 +1822,8 @@ def ring_hop_bwd_engine_for(q, k, v, *, p: int | None = None,
     stamp = f"pallas:b{blk}"
     if kind == "expand":
         stamp += f":kvx{groups}"
+    if _ring_prefetch_on(p):
+        stamp += ":pf"
     return stamp
 
 

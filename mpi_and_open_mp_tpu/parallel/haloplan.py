@@ -21,6 +21,16 @@ round of ``k`` steps (ghost depth ``d = k * radius``) into
   from a ``3d``-deep extension ``concat([ghost, edge_2d])`` after the
   ghost ``ppermute`` completes.
 
+The boundary itself can be **partitioned** (``boundary_steps < fuse_steps``):
+each edge strip advances in ``boundary_steps``-deep sub-rounds, and every
+sub-round's ghost send is issued straight from that strip's freshly
+computed cells — per-edge readiness signalling instead of one
+barrier-shaped exchange per fused round, arxiv 2508.13370's
+``MPI_Pready`` analogue. The interior keeps the full ``fuse_steps``
+depth (deeper interior, shallower edges); total ghost volume is
+unchanged but moves in ``fuse_steps / boundary_steps`` smaller per-edge
+messages that pipeline behind the interior chain.
+
 The ghost permutes are issued FIRST and consumed LAST: they have no data
 dependence on the interior compute, so XLA's latency-hiding scheduler
 pairs the collective-permute start with a done AFTER the interior stencil
@@ -43,7 +53,12 @@ Engine stamps (ledger/sentinel provenance — ``seq:`` is the downgrade):
 
 * ``overlap:deferred`` — deferred-concat schedule, every backend.
 * ``overlap:rdma``     — ghosts move by Pallas async remote copy
-  (``MOMP_HALO_RDMA=1``, real TPU, row layout); schedule unchanged.
+  (``MOMP_HALO_RDMA=1``, real TPU, every layout: row/col exchange their
+  edge pair over the 1-D ring, cart runs the two-phase corner exchange —
+  y edges first, then x edges carrying the corner words); schedule
+  unchanged.
+* ``…:pb{b}``          — suffix on either overlap stamp when the
+  boundary is partitioned at ``boundary_steps = b < fuse_steps``.
 * ``overlap:packed``   — the bit-sliced twin (``ops.bitlife``
   ``make_overlap_steppers``): 32 boards per halo word.
 * ``seq:halo`` / ``seq:packed`` — the sequential fallback, stamped with
@@ -83,8 +98,9 @@ def rdma_requested() -> bool:
     """Whether ``MOMP_HALO_RDMA=1`` asks for the explicit Pallas
     async-remote-copy ghost path (default OFF: the deferred ``ppermute``
     schedule already overlaps via XLA's latency-hiding scheduler, and
-    the RDMA kernel is the experimental rung the r07 chip queue
-    exercises — see DESIGN.md §17)."""
+    the RDMA kernels are the chip rung the r08 queue exercises —
+    ``launchers/queue_r08/30_partitioned_halo_ring.sh``; see DESIGN.md
+    §20 for the layout matrix)."""
     return os.environ.get(ENV_RDMA, "0") == "1"
 
 
@@ -99,6 +115,8 @@ class HaloPlan:
     shard_shape: tuple[int, int] # local (h, w) cell extent per shard
     radius: int
     fuse_steps: int
+    boundary_steps: int          # edge sub-round depth; == fuse_steps
+                                 # for the coupled (one-exchange) round
     channels: int
     pack_layout: str             # "cell" | "packed"
     depth: int                   # radius * fuse_steps, ghost cells/side
@@ -109,17 +127,18 @@ class HaloPlan:
 
 def _overlap_axis(layout: str) -> str:
     """The axis whose exchange the plan overlaps: the sharded row axis
-    for ``row``/``cart`` (cart's x exchange stays sequential — its
-    ghosts feed the y ghosts' corners, a real data dependence), the
-    column axis for ``col``."""
+    for ``row``/``cart`` (cart's x exchange on the deferred path stays
+    sequential — its ghosts feed the y ghosts' corners, a real data
+    dependence; the RDMA rung folds it into phase 2 of the corner
+    exchange), the column axis for ``col``."""
     return "x" if layout == "col" else "y"
 
 
 @functools.lru_cache(maxsize=512)
 def _plan(layout: str, mesh_axes: tuple[int, int],
           shard_shape: tuple[int, int], radius: int, fuse_steps: int,
-          channels: int, pack_layout: str, enabled: bool,
-          rdma: bool) -> HaloPlan:
+          boundary_steps: int, channels: int, pack_layout: str,
+          enabled: bool, rdma: bool) -> HaloPlan:
     depth = radius * fuse_steps
     py, px = mesh_axes
     h, w = shard_shape
@@ -130,11 +149,20 @@ def _plan(layout: str, mesh_axes: tuple[int, int],
     def seq(why: str) -> HaloPlan:
         stamp = "seq:packed" if pack_layout == "packed" else "seq:halo"
         return HaloPlan(layout, mesh_axes, shard_shape, radius,
-                        fuse_steps, channels, pack_layout, depth,
-                        False, stamp, why)
+                        fuse_steps, fuse_steps, channels, pack_layout,
+                        depth, False, stamp, why)
 
     if layout not in LAYOUTS:
         raise ValueError(f"layout must be one of {LAYOUTS}, got {layout!r}")
+    if (boundary_steps < 1 or boundary_steps > fuse_steps
+            or fuse_steps % boundary_steps):
+        raise ValueError(
+            f"boundary_steps={boundary_steps} must divide "
+            f"fuse_steps={fuse_steps}")
+    if pack_layout == "packed" and boundary_steps != fuse_steps:
+        raise ValueError(
+            "packed frames keep the coupled boundary depth "
+            "(boundary_steps == fuse_steps)")
     if not enabled:
         return seq(f"{ENV_OVERLAP}=0")
     if shards <= 1:
@@ -145,24 +173,31 @@ def _plan(layout: str, mesh_axes: tuple[int, int],
             "empty interior")
     if pack_layout == "packed":
         engine = "overlap:packed"
-    elif rdma and layout == "row" and jax.default_backend() == "tpu":
+    elif rdma and jax.default_backend() == "tpu":
         engine = "overlap:rdma"
     else:
         engine = "overlap:deferred"
+    if boundary_steps != fuse_steps:
+        engine += f":pb{boundary_steps}"
     return HaloPlan(layout, mesh_axes, shard_shape, radius, fuse_steps,
-                    channels, pack_layout, depth, True, engine, "")
+                    boundary_steps, channels, pack_layout, depth, True,
+                    engine, "")
 
 
 def plan_halo(layout: str, mesh_axes: tuple[int, int],
               shard_shape: tuple[int, int], radius: int,
-              fuse_steps: int = 1, *, channels: int = 1,
+              fuse_steps: int = 1, *, boundary_steps: int | None = None,
+              channels: int = 1,
               pack_layout: str = "cell") -> HaloPlan:
     """Derive (or fetch) the persistent plan for one geometry. The env
     kill switch and the RDMA opt-in are part of the cache key: flipping
     ``MOMP_HALO_OVERLAP`` mid-process yields a fresh plan, never a stale
-    cached schedule."""
+    cached schedule. ``boundary_steps`` (default: coupled, ==
+    ``fuse_steps``) partitions the boundary into shallower per-edge
+    sub-rounds; it must divide ``fuse_steps``."""
+    bs = fuse_steps if boundary_steps is None else int(boundary_steps)
     return _plan(layout, tuple(mesh_axes), tuple(shard_shape),
-                 int(radius), int(fuse_steps), int(channels),
+                 int(radius), int(fuse_steps), bs, int(channels),
                  pack_layout, overlap_enabled(), rdma_requested())
 
 
@@ -225,24 +260,30 @@ def packed_ghosts_y(q: jnp.ndarray, h: int,
 # ------------------------------------------- Pallas async remote copy (TPU)
 
 
-def _rdma_ghosts_y(block: jnp.ndarray, depth: int, axis_name: str,
-                   p: int) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Ghost pair by explicit Pallas async remote copy over the ring.
+def _rdma_edge_pair(fwd_edge: jnp.ndarray, bwd_edge: jnp.ndarray,
+                    axis_name: str, p: int, *, collective_id: int
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One ghost-pair exchange by explicit Pallas async remote copy.
 
-    Each device starts two RDMAs — its bottom edge into the successor's
-    ``top`` buffer, its top edge into the predecessor's ``bot`` buffer —
-    after a neighbour barrier (both peers must have entered the kernel
-    before a remote write may land). Semantically identical to
-    :func:`ghosts_y`; the difference is WHO schedules the transfer: here
-    the DMA engines are driven directly instead of through the
-    collective-permute lowering. Real-TPU only (``MOMP_HALO_RDMA=1``,
-    row layout, 1-D mesh) — the r07 launcher exercises it on chip; CPU
-    CI stays on the deferred ``ppermute`` schedule.
+    Each device starts two RDMAs — ``fwd_edge`` into the ring
+    successor's first output buffer, ``bwd_edge`` into the
+    predecessor's second — after a neighbour barrier (both peers must
+    have entered the kernel before a remote write may land). Returns
+    ``(from_prev, from_next)``: the predecessor's ``fwd_edge`` and the
+    successor's ``bwd_edge``. Semantically identical to a ``ppermute``
+    pair; the difference is WHO schedules the transfer: here the DMA
+    engines are driven directly instead of through the
+    collective-permute lowering. Real-TPU only (``MOMP_HALO_RDMA=1``) —
+    the r08 launcher exercises it on chip; CPU CI stays on the deferred
+    ``ppermute`` schedule. Transport only: chaos injection and ghost
+    orientation live in the ``_rdma_ghosts_*`` wrappers so every layout
+    funnels through ``halo._chaos_ghost`` exactly like the deferred
+    path.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    def kernel(bot_edge, top_edge, top_out, bot_out, s1, r1, s2, r2):
+    def kernel(fwd, bwd, prev_out, next_out, s1, r1, s2, r2):
         i = lax.axis_index(axis_name)
         nxt = lax.rem(i + 1, p)
         prv = lax.rem(i + p - 1, p)
@@ -255,27 +296,77 @@ def _rdma_ghosts_y(block: jnp.ndarray, depth: int, axis_name: str,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
         send_fwd = pltpu.make_async_remote_copy(
-            src_ref=bot_edge, dst_ref=top_out, send_sem=s1, recv_sem=r1,
+            src_ref=fwd, dst_ref=prev_out, send_sem=s1, recv_sem=r1,
             device_id=(nxt,), device_id_type=pltpu.DeviceIdType.LOGICAL)
         send_bwd = pltpu.make_async_remote_copy(
-            src_ref=top_edge, dst_ref=bot_out, send_sem=s2, recv_sem=r2,
+            src_ref=bwd, dst_ref=next_out, send_sem=s2, recv_sem=r2,
             device_id=(prv,), device_id_type=pltpu.DeviceIdType.LOGICAL)
         send_fwd.start()
         send_bwd.start()
         send_fwd.wait()
         send_bwd.wait()
 
-    edge = jax.ShapeDtypeStruct(
-        block[..., -depth:, :].shape, block.dtype)
-    top, bot = pl.pallas_call(
+    from_prev, from_next = pl.pallas_call(
         kernel,
-        out_shape=(edge, edge),
+        out_shape=(jax.ShapeDtypeStruct(fwd_edge.shape, fwd_edge.dtype),
+                   jax.ShapeDtypeStruct(bwd_edge.shape, bwd_edge.dtype)),
         in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 2,
         out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 2,
         scratch_shapes=[pltpu.SemaphoreType.DMA] * 4,
-        compiler_params=pltpu.TPUCompilerParams(collective_id=13),
-    )(block[..., -depth:, :], block[..., :depth, :])
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=collective_id),
+    )(fwd_edge, bwd_edge)
+    return from_prev, from_next
+
+
+def _rdma_ghosts_y(block: jnp.ndarray, depth: int, axis_name: str,
+                   p: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`ghosts_y` by RDMA — bottom edge forward, top edge
+    backward over the y ring (row/cart layouts); chaos hook on the top
+    ghost, mirroring the deferred path's injection point."""
+    top, bot = _rdma_edge_pair(
+        block[..., -depth:, :], block[..., :depth, :], axis_name, p,
+        collective_id=13)
     return halo._chaos_ghost(top), bot
+
+
+def _rdma_ghosts_x(block: jnp.ndarray, depth: int, axis_name: str,
+                   p: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`ghosts_x` by RDMA — the x-mirror schedule for the ``col``
+    layout: right edge forward, left edge backward over the x ring."""
+    left, right = _rdma_edge_pair(
+        block[..., -depth:], block[..., :depth], axis_name, p,
+        collective_id=14)
+    return halo._chaos_ghost(left), right
+
+
+def _rdma_ghosts_cart(block: jnp.ndarray, depth: int,
+                      mesh_axes: tuple[int, int]
+                      ) -> tuple[jnp.ndarray, jnp.ndarray,
+                                 jnp.ndarray, jnp.ndarray]:
+    """Two-phase cart corner exchange by RDMA: y edges first, then x
+    edges carrying the corner words.
+
+    Phase 1 moves the raw y edge pair over the y ring. Phase 2 moves
+    the x edge pair OF THE Y-PADDED BLOCK over the x ring — each
+    ``(h + 2d, d)`` column strip's first/last ``d`` rows are phase 1's
+    freshly landed ghosts, so the diagonal corner words ride the x
+    exchange without a third (diagonal) transfer, the same forwarding
+    the sequential schedule gets from ``halo.halo_pad_2d``'s pad-x-
+    then-pad-y order. Returns ``(top, bot, left, right)`` with
+    ``top``/``bot`` of shape ``(..., d, w)`` and ``left``/``right`` of
+    shape ``(..., h + 2d, d)`` (corners included)."""
+    d = depth
+    py, px = mesh_axes
+    top, bot = _rdma_edge_pair(
+        block[..., -d:, :], block[..., :d, :], "y", py,
+        collective_id=13)
+    top = halo._chaos_ghost(top)
+    pady = jnp.concatenate([top, block, bot], axis=-2)
+    left, right = _rdma_edge_pair(
+        pady[..., -d:], pady[..., :d], "x", px, collective_id=14)
+    left = halo._chaos_ghost(left)
+    return top, bot, left, right
 
 
 # --------------------------------------------------------- fused schedules
@@ -299,12 +390,19 @@ def overlap_fused_step(plan: HaloPlan, step_fn, block: jnp.ndarray
     """
     if not plan.overlap:
         return sequential_fused_step(plan, step_fn, block)
+    if plan.boundary_steps != plan.fuse_steps:
+        return _partitioned_fused_step(plan, step_fn, block)
     _note_schedule(plan)
     k, d = plan.fuse_steps, plan.depth
+    rdma = plan.engine.startswith("overlap:rdma")
     if plan.layout == "col":
         # x-mirror of the row schedule: interior pads y locally (the
         # unsharded axis wraps itself), boundary strips extend in x.
-        left, right = ghosts_x(block, d)
+        if rdma:
+            left, right = _rdma_ghosts_x(block, d, "x",
+                                         plan.mesh_axes[1])
+        else:
+            left, right = ghosts_x(block, d)
         wrapped = jnp.concatenate(
             [block[..., -d:, :], block, block[..., :d, :]], axis=-2)
         interior = _steps(step_fn, wrapped, k)
@@ -318,8 +416,30 @@ def overlap_fused_step(plan: HaloPlan, step_fn, block: jnp.ndarray
                 [tail[..., -d:, :], tail, tail[..., :d, :]], axis=-2), k)
         return jnp.concatenate([lead, interior, tail], axis=-1)
 
-    # row / cart: overlap the y exchange. cart first completes the x
-    # exchange sequentially (its ghost columns feed the y ghosts'
+    if plan.layout == "cart" and rdma and plan.mesh_axes[1] > 1:
+        # Two-phase corner exchange inside the RDMA kernels: y edges
+        # first, then x edges carrying the corner words — both axes'
+        # ghosts fly while the interior computes (the deferred cart
+        # path below still serialises the x exchange up front).
+        top2, bot2, left, right = _rdma_ghosts_cart(
+            block, d, plan.mesh_axes)
+        base = jnp.concatenate(
+            [left[..., d:-d, :], block, right[..., d:-d, :]], axis=-1)
+        top = jnp.concatenate(
+            [left[..., :d, :], top2, right[..., :d, :]], axis=-1)
+        bot = jnp.concatenate(
+            [left[..., -d:, :], bot2, right[..., -d:, :]], axis=-1)
+        interior = _steps(step_fn, base, k)
+        lead = _steps(
+            step_fn, jnp.concatenate([top, base[..., : 2 * d, :]],
+                                     axis=-2), k)
+        tail = _steps(
+            step_fn, jnp.concatenate([base[..., -2 * d:, :], bot],
+                                     axis=-2), k)
+        return jnp.concatenate([lead, interior, tail], axis=-2)
+
+    # row / cart: overlap the y exchange. Deferred cart first completes
+    # the x exchange sequentially (its ghost columns feed the y ghosts'
     # corners — the reference's two-phase order, life_cart.c:275-279);
     # row wraps x locally. Either way `base` carries d ghost columns.
     if plan.layout == "cart":
@@ -327,7 +447,7 @@ def overlap_fused_step(plan: HaloPlan, step_fn, block: jnp.ndarray
     else:
         base = jnp.concatenate(
             [block[..., -d:], block, block[..., :d]], axis=-1)
-    if plan.engine == "overlap:rdma":
+    if rdma:
         top, bot = _rdma_ghosts_y(base, d, "y", plan.mesh_axes[0])
     else:
         top, bot = ghosts_y(base, d)
@@ -336,6 +456,78 @@ def overlap_fused_step(plan: HaloPlan, step_fn, block: jnp.ndarray
         step_fn, jnp.concatenate([top, base[..., : 2 * d, :]], axis=-2), k)
     tail = _steps(
         step_fn, jnp.concatenate([base[..., -2 * d:, :], bot], axis=-2), k)
+    return jnp.concatenate([lead, interior, tail], axis=-2)
+
+
+def _partitioned_fused_step(plan: HaloPlan, step_fn, block: jnp.ndarray
+                            ) -> jnp.ndarray:
+    """The partitioned-boundary round: interior keeps the full
+    ``k = fuse_steps`` fuse; each edge strip advances in
+    ``b = boundary_steps`` sub-rounds, exchanging ``radius * b``-deep
+    per-edge ghosts whose sends are issued straight from the strip's
+    just-computed cells (per-edge readiness, no whole-round barrier —
+    the ``MPI_Pready`` shape of arxiv 2508.13370). Sub-round ``j``'s
+    ghost is the neighbour strip's state at step ``j * b``, so the
+    reassembled shard is bit-identical to the coupled round: every
+    output cell sees the same neighbourhood values through the same
+    reduction tree, only sliced along different message boundaries.
+    Band extents shrink by ``radius * b`` per side per sub-round along
+    the unsharded axis exactly as the coupled strips shrink over ``k``
+    fused applications."""
+    _note_schedule(plan)
+    k, d, b = plan.fuse_steps, plan.depth, plan.boundary_steps
+    e = plan.radius * b
+    rdma = plan.engine.startswith("overlap:rdma")
+    if plan.layout == "col":
+        base = jnp.concatenate(
+            [block[..., -d:, :], block, block[..., :d, :]], axis=-2)
+        interior = _steps(step_fn, base, k)
+        lead, tail = base[..., : 2 * d], base[..., -2 * d:]
+        p = halo._axis_size("x")
+        for _ in range(k // b):
+            halo._note_exchange("x-part", "x")
+            if rdma:
+                left, right = _rdma_edge_pair(
+                    tail[..., -e:], lead[..., :e], "x", p,
+                    collective_id=14)
+                left = halo._chaos_ghost(left)
+            else:
+                left = halo._chaos_ghost(lax.ppermute(
+                    tail[..., -e:], "x", halo.ring_perm(p, 1)))
+                right = lax.ppermute(
+                    lead[..., :e], "x", halo.ring_perm(p, -1))
+            lead = _steps(
+                step_fn, jnp.concatenate([left, lead], axis=-1), b)
+            tail = _steps(
+                step_fn, jnp.concatenate([tail, right], axis=-1), b)
+        return jnp.concatenate([lead, interior, tail], axis=-1)
+
+    # row / cart: bands along y. Cart pre-pads x sequentially (corners
+    # ride the x ghosts, which then shrink with the band), row wraps x
+    # locally; either way each band starts with d ghost columns and
+    # narrows by e per side per sub-round.
+    if plan.layout == "cart":
+        base = halo.halo_pad_x(block, "x", d)
+    else:
+        base = jnp.concatenate(
+            [block[..., -d:], block, block[..., :d]], axis=-1)
+    interior = _steps(step_fn, base, k)
+    lead, tail = base[..., : 2 * d, :], base[..., -2 * d:, :]
+    p = halo._axis_size("y")
+    for _ in range(k // b):
+        halo._note_exchange("y-part", "y")
+        if rdma:
+            top, bot = _rdma_edge_pair(
+                tail[..., -e:, :], lead[..., :e, :], "y", p,
+                collective_id=13)
+            top = halo._chaos_ghost(top)
+        else:
+            top = halo._chaos_ghost(lax.ppermute(
+                tail[..., -e:, :], "y", halo.ring_perm(p, 1)))
+            bot = lax.ppermute(
+                lead[..., :e, :], "y", halo.ring_perm(p, -1))
+        lead = _steps(step_fn, jnp.concatenate([top, lead], axis=-2), b)
+        tail = _steps(step_fn, jnp.concatenate([tail, bot], axis=-2), b)
     return jnp.concatenate([lead, interior, tail], axis=-2)
 
 

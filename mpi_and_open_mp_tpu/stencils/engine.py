@@ -274,6 +274,7 @@ def fused_steps_valid(spec: StencilSpec, shard_shape: tuple[int, int],
 
 def make_sharded_runner(spec: StencilSpec, mesh, layout: str,
                         shape: tuple[int, int], *, fuse_steps: int = 1,
+                        boundary_steps: int | None = None,
                         overlap: bool | None = None):
     """Build ``(run, plan)`` for a sharded board: ``run(board, n)``
     advances ``n`` torus steps via plan-scheduled shard_map halo rounds.
@@ -281,9 +282,12 @@ def make_sharded_runner(spec: StencilSpec, mesh, layout: str,
     ``overlap=None`` lets the plan decide (geometry + the
     ``MOMP_HALO_OVERLAP`` kill switch); ``False`` forces the sequential
     schedule — the A/B baseline leg — and stamps ``why`` accordingly.
-    ``run`` is jit-cached per static ``n`` (remainder rounds get their
-    own smaller-depth plan, which may legally degrade to sequential
-    even when the main rounds overlap).
+    ``boundary_steps`` (default: coupled) partitions each round's
+    boundary into shallower per-edge sub-exchanges; it must divide
+    ``fuse_steps``. ``run`` is jit-cached per static ``n`` (remainder
+    rounds get their own smaller-depth plan — coupled boundary, and
+    possibly a legal sequential degrade — even when the main rounds
+    overlap partitioned).
     """
     import dataclasses as _dc
     import functools as _ft
@@ -307,7 +311,9 @@ def make_sharded_runner(spec: StencilSpec, mesh, layout: str,
             f"shard {shard}")
 
     def plan_for(k: int) -> "haloplan.HaloPlan":
+        bs = boundary_steps if k == fuse_steps else None
         p = haloplan.plan_halo(layout, (py, px), shard, spec.radius, k,
+                               boundary_steps=bs,
                                channels=spec.channels)
         if overlap is False and p.overlap:
             p = _dc.replace(p, overlap=False, engine="seq:halo",
@@ -344,6 +350,7 @@ def make_sharded_runner(spec: StencilSpec, mesh, layout: str,
 
 def run_sharded(spec: StencilSpec, board, n: int, *, mesh,
                 layout: str = "row", fuse_steps: int = 1,
+                boundary_steps: int | None = None,
                 overlap: bool | None = None):
     """Advance ``n`` sharded steps under a ``halo.overlap`` /
     ``halo.seq`` trace span (host-level: the span brackets dispatch
@@ -360,7 +367,8 @@ def run_sharded(spec: StencilSpec, board, n: int, *, mesh,
 
     run, plan = make_sharded_runner(
         spec, mesh, layout, tuple(board.shape[-2:]),
-        fuse_steps=fuse_steps, overlap=overlap)
+        fuse_steps=fuse_steps, boundary_steps=boundary_steps,
+        overlap=overlap)
     run_sharded.last_plan = plan
     sharding = NamedSharding(mesh, _sharded_pspec(layout, spec.channels))
     board = jax.device_put(jnp.asarray(board, spec.dtype), sharding)

@@ -281,9 +281,12 @@ class PlanStore:
             mesh = mesh_lib.make_mesh_2d(py, px)
             board = spec.init(np.random.default_rng(_PARITY_SEED),
                               (ny, nx))
+            fuse = int(choice.get("fuse_steps", 1))
             out = stencil_engine.run_sharded(
                 spec, board, PARITY_STEPS, mesh=mesh,
                 layout=str(choice["axis_order"]),
+                fuse_steps=fuse,
+                boundary_steps=int(choice.get("boundary_steps", fuse)),
                 overlap=(None if choice.get("halo_overlap") == "overlap"
                          else False))
             ok = stencils.parity_ok(

@@ -54,12 +54,42 @@ class Candidate:
     #: decide exchange-vs-skip per round); "-" for single-device paths,
     #: where there is no exchange to schedule.
     halo_overlap: str = "-"
+    #: Interior fuse depth for sharded candidates: steps fused per ghost
+    #: round (ghost depth = ``fuse_steps * radius``). 1 everywhere else.
+    fuse_steps: int = 1
+    #: Boundary sub-round depth for sharded overlap candidates —
+    #: ``== fuse_steps`` is the coupled one-exchange round, a smaller
+    #: divisor partitions each edge strip into per-edge sub-exchanges
+    #: (deeper interior, shallower edges — arxiv 2508.13370).
+    boundary_steps: int = 1
 
 
 #: Tile edge the sparse-sharded candidates profile at — one fixed rung
 #: (PR 13's sweep showed tile choice is second-order next to the
 #: sparse-vs-dense decision itself, which is what the race measures).
 SPARSE_SHARDED_TILE = 64
+
+
+def sharded_fuse_depths() -> tuple[int, ...]:
+    """Interior fuse depths the sharded space enumerates.
+    ``MOMP_TUNE_FUSE_DEPTHS`` (comma list) overrides the default
+    ``(1, 2)`` — the r08 chip queue sweeps deeper rungs where exposed
+    transfer makes depth worth buying; the CPU default keeps the tuner
+    pass bounded. Depth 1 (the coupled heuristic's rung) is always
+    included so the heuristic stays in the race."""
+    import os
+
+    raw = os.environ.get("MOMP_TUNE_FUSE_DEPTHS", "1,2")
+    depths = sorted({max(1, int(tok)) for tok in raw.split(",") if tok})
+    return tuple(depths) if 1 in depths else (1, *depths)
+
+
+def _boundary_depths(fuse_steps: int) -> tuple[int, ...]:
+    """Legal boundary sub-round depths for one interior depth: every
+    divisor, coupled (``== fuse_steps``) first so the one-exchange round
+    opens each depth's slate."""
+    return tuple(b for b in range(fuse_steps, 0, -1)
+                 if fuse_steps % b == 0)
 
 
 def axis_orders(device_count: int = 1,
@@ -120,6 +150,31 @@ def sharded_candidates(workload: str, shape: tuple[int, int],
                 workload=str(workload), path=f"sharded:{layout}",
                 pack_layout="-", bucket_rounding=BUCKET_POW2,
                 axis_order=layout, halo_overlap=sched))
+        # Interior depth x boundary depth, enumerated independently:
+        # deeper interiors amortise the exchange, shallower boundaries
+        # partition it into per-edge sub-sends. Each pair is legality-
+        # gated by the persistent plan itself (a depth that empties the
+        # interior degrades to seq and is not re-listed). Depth (1, 1)
+        # — the coupled heuristic — is already listed above, so
+        # ``vs_heuristic`` stays >= 1.0 by construction.
+        if plan.overlap:
+            for k in sharded_fuse_depths():
+                if not stencil_engine.fused_steps_valid(spec, shard, k):
+                    continue
+                for b in _boundary_depths(k):
+                    if (k, b) == (1, 1):
+                        continue
+                    pk = haloplan.plan_halo(
+                        layout, (py, px), shard, spec.radius, k,
+                        boundary_steps=b, channels=spec.channels)
+                    if not pk.overlap:
+                        continue
+                    out.append(Candidate(
+                        workload=str(workload),
+                        path=f"sharded:{layout}",
+                        pack_layout="-", bucket_rounding=BUCKET_POW2,
+                        axis_order=layout, halo_overlap="overlap",
+                        fuse_steps=k, boundary_steps=b))
         if spec.channels == 1:
             sp = sparse_sharded.plan_sparse_sharded(
                 layout, (py, px), shard, spec.radius,

@@ -830,25 +830,34 @@ def test_ring_hop_engine_routing(monkeypatch):
     assert context.ring_hop_engine_for(*qkv(), p=8) == "jnp"
 
     monkeypatch.setattr(context.jax, "default_backend", lambda: "tpu")
-    # 8k global over 8 devices -> 1k hop blocks.
-    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    # 8k global over 8 devices -> 1k hop blocks. p >= 3 rings run the
+    # double-slot hop prefetch by default and the stamp says so.
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024:pf"
     # GQA hops expand locally per hop; the stamp says so.
     assert (context.ring_hop_engine_for(*qkv(hkv=2), p=8)
-            == "pallas:b1024:kvx2")
+            == "pallas:b1024:kvx2:pf")
     # Causal zigzag decomposes each hop into half-chunk kernel calls:
     # eligibility and block edge are judged on the (h, nl/2, d) half
     # shape and the stamp says so (1k hop blocks -> 512 halves).
     # Non-causal zigzag has no masks, so it takes the contiguous form.
     assert context.ring_hop_engine_for(
-        *qkv(), p=8, causal=True, layout="zigzag") == "pallas:b512:zz"
+        *qkv(), p=8, causal=True, layout="zigzag") == "pallas:b512:zz:pf"
     assert context.ring_hop_engine_for(
-        *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b1024"
+        *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b1024:pf"
     # MOMP_RING_ZZ=0 pins causal zigzag (and only it) to the jnp fold.
     monkeypatch.setattr(context, "_RING_ZZ", False)
     assert context.ring_hop_engine_for(
         *qkv(), p=8, causal=True, layout="zigzag") == "jnp"
-    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024:pf"
     monkeypatch.setattr(context, "_RING_ZZ", True)
+    # MOMP_RING_PREFETCH=0 drops back to the single-slot schedule (and
+    # only that — the hop kernel stays); a 2-device ring has a single
+    # transfer, so it never stamps :pf regardless of the gate.
+    monkeypatch.setattr(context, "_RING_PREFETCH", False)
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    monkeypatch.setattr(context, "_RING_PREFETCH", True)
+    assert context.ring_hop_engine_for(*qkv(n=2048), p=2) \
+        == "pallas:b1024"
     # Hop blocks that fail the kernel predicate (seq % 128) fall back.
     assert context.ring_hop_engine_for(*qkv(n=8 * 1000), p=8) == "jnp"
     # A 1-device ring never enters the ring body: local provenance.
@@ -876,17 +885,22 @@ def test_ring_hop_bwd_engine_routing(monkeypatch):
 
     monkeypatch.setattr(context.jax, "default_backend", lambda: "tpu")
     # 1k hop blocks: the forward edge is b1024, the hop backward caps
-    # at the kernels' VMEM-budget MAX_BLOCK (512).
-    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "pallas:b512"
+    # at the kernels' VMEM-budget MAX_BLOCK (512). The K/V trip
+    # prefetches exactly as the forward's — the stamp carries :pf.
+    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "pallas:b512:pf"
     # GQA hops expand per hop, like the forward engine.
     assert (context.ring_hop_bwd_engine_for(*qkv(hkv=2), p=8)
-            == "pallas:b512:kvx2")
+            == "pallas:b512:kvx2:pf")
     # Causal zigzag gradients stay on the jnp fold (the half-chunk
     # decomposition is forward-only); non-causal zigzag is maskless.
     assert context.ring_hop_bwd_engine_for(
         *qkv(), p=8, causal=True, layout="zigzag") == "jnp"
     assert context.ring_hop_bwd_engine_for(
-        *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b512"
+        *qkv(), p=8, causal=False, layout="zigzag") == "pallas:b512:pf"
+    # MOMP_RING_PREFETCH=0: single-slot K/V trip, kernel hops stay.
+    monkeypatch.setattr(context, "_RING_PREFETCH", False)
+    assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "pallas:b512"
+    monkeypatch.setattr(context, "_RING_PREFETCH", True)
     assert context.ring_hop_bwd_engine_for(*qkv(n=8 * 1000), p=8) == "jnp"
     assert (context.ring_hop_bwd_engine_for(*qkv(), p=1)
             == "local:pallas:b512")
@@ -894,7 +908,7 @@ def test_ring_hop_bwd_engine_routing(monkeypatch):
     # kernel. MOMP_RING_HOP=0 pins both.
     monkeypatch.setattr(context, "_RING_HOP_BWD", False)
     assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "jnp"
-    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024"
+    assert context.ring_hop_engine_for(*qkv(), p=8) == "pallas:b1024:pf"
     monkeypatch.setattr(context, "_RING_HOP_BWD", True)
     monkeypatch.setattr(context, "_RING_HOP", False)
     assert context.ring_hop_bwd_engine_for(*qkv(), p=8) == "jnp"
@@ -902,15 +916,20 @@ def test_ring_hop_bwd_engine_routing(monkeypatch):
 
 def test_ring_hop_pinned_pins_both_directions():
     """The chaos-recovery pin (_ring_hop_pinned(False)) must pin BOTH
-    hop engines: the :recovered re-dispatch promises the full jnp fold
-    oracle, forward and backward."""
+    hop engines AND the hop prefetch: the :recovered re-dispatch
+    promises the full single-slot jnp fold oracle, forward and
+    backward."""
     from mpi_and_open_mp_tpu.parallel import context
 
     assert context._RING_HOP and context._RING_HOP_BWD
+    assert context._RING_PREFETCH
     with context._ring_hop_pinned(False):
         assert not context._RING_HOP
         assert not context._RING_HOP_BWD
+        assert not context._RING_PREFETCH
+        assert not context._ring_prefetch_on(8)
     assert context._RING_HOP and context._RING_HOP_BWD
+    assert context._RING_PREFETCH
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -930,7 +949,8 @@ def test_ring_hop_flash_interpret_parity(rng, sp_mesh, pallas_interpret,
     sp_mesh_p = sp_mesh.shape["sp"]
 
     stamp = context.ring_hop_engine_for(q, k, v, p=sp_mesh_p, causal=causal)
-    assert stamp == ("pallas:b128" if hkv == h else "pallas:b128:kvx2")
+    assert stamp == ("pallas:b128:pf" if hkv == h
+                     else "pallas:b128:kvx2:pf")
 
     kr = jnp.repeat(k, h // hkv, axis=0)
     vr = jnp.repeat(v, h // hkv, axis=0)
@@ -1052,7 +1072,8 @@ def test_ring_hop_bwd_kill_switch_matches_kernel(rng, sp_mesh,
     v = jnp.asarray(rng.standard_normal((hkv, n, d)), jnp.float32)
     p = sp_mesh.shape["sp"]
 
-    want_stamp = "pallas:b128" if hkv == h else "pallas:b128:kvx2"
+    want_stamp = ("pallas:b128:pf" if hkv == h
+                  else "pallas:b128:kvx2:pf")
     assert context.ring_hop_bwd_engine_for(
         q, k, v, p=p, causal=True) == want_stamp
 
@@ -1098,7 +1119,8 @@ def test_ring_zigzag_hopflash_interpret_parity(rng, sp_mesh,
 
     stamp = context.ring_hop_engine_for(q, k, v, p=p, causal=True,
                                         layout="zigzag")
-    assert stamp == ("pallas:b128:zz" if hkv == h else "pallas:b128:kvx2:zz")
+    assert stamp == ("pallas:b128:zz:pf" if hkv == h
+                     else "pallas:b128:kvx2:zz:pf")
     # Zigzag gradients stay on the jnp fold — truthful provenance.
     assert context.ring_hop_bwd_engine_for(
         q, k, v, p=p, causal=True, layout="zigzag") == "jnp"
@@ -1152,8 +1174,11 @@ def test_ring_hop_engines_chaos_recovery_interplay(rng, sp_mesh,
     h, n, d = 2, 8 * 128, 128
     q, k, v = (jnp.asarray(rng.standard_normal((h, n, d)), jnp.float32)
                for _ in range(3))
-    assert context.ring_hop_engine_for(
-        q, k, v, p=sp_mesh.shape["sp"], causal=True).startswith("pallas:")
+    stamp = context.ring_hop_engine_for(
+        q, k, v, p=sp_mesh.shape["sp"], causal=True)
+    # The poisoned hop is the PREFETCHED one (:pf): recovery must pin
+    # the double-slot schedule off along with both kernels.
+    assert stamp.startswith("pallas:") and stamp.endswith(":pf")
 
     monkeypatch.setenv("MOMP_CHAOS", "nan_hop=2;seed=7")
     chaos.reset()

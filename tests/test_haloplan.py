@@ -434,6 +434,14 @@ def test_bench_sharded_ab_phase(monkeypatch):
     assert fields["vs_sequential"] > 0
     assert 0.0 <= fields["sharded_overlap_efficiency"] <= 1.0
     assert fields["sharded_exposed_s"] <= fields["sharded_transfer_s"]
+    # PR 18: the partitioned-boundary sweep rides the same phase — all
+    # three layouts parity-green under the split boundary, stamped and
+    # priced against the coupled schedule.
+    assert fields["sharded_boundary_parity"] is True
+    for lay in ("row", "col", "cart"):
+        assert fields["sharded_boundary_engines"][lay].endswith(":pb1")
+    assert fields["sharded_boundary_cups"] > 0
+    assert fields["sharded_boundary_vs_coupled"] > 0
     # The kill switch downgrades the stamp on the SAME phase call — the
     # provenance signal the sentinel alarms on.
     monkeypatch.setenv(haloplan.ENV_OVERLAP, "0")
