@@ -165,6 +165,16 @@ WATCH_FIELDS = (
     # pallas tier — see ``_prefetch_rank``.
     "ring_prefetch_tflops",
     "ring_exposed_s",
+    # Fleet telemetry plane (PR 19): snapshot loss is the fraction of
+    # the per-worker time series the rollup never received (seq gaps +
+    # truncated sidecar frames) — growing loss means the shipping path
+    # is dropping intervals (lower by the ``loss`` rule). The burn-rate
+    # peak at the saturation knee is the long-window error-budget
+    # consumption while the SLO is still MET — recorded headroom; a
+    # rising peak means the fleet runs ever closer to its budget at the
+    # same capacity number (lower by the ``burn`` rule).
+    "telemetry_snapshot_loss_frac",
+    "loadgen_burn_rate_peak",
 )
 
 
@@ -178,14 +188,16 @@ def direction_for(field: str) -> str:
     ``*_bytes`` suffixes, ``shed``/``degrad`` counters) are
     lower-is-better: a p99 that GROWS is the regression, and so is a
     write-ahead-journal durability tax that swells (``serve_wal_bytes``
-    volume, ``serve_wal_fsync_s`` sync stall). Anything unrecognised
-    defaults to higher-is-better (the historical behaviour for
-    throughput fields).
+    volume, ``serve_wal_fsync_s`` sync stall). Telemetry badness is
+    lower-is-better too: ``loss`` (snapshot series the rollup never
+    saw) and ``burn`` (SLO error-budget consumption rate). Anything
+    unrecognised defaults to higher-is-better (the historical
+    behaviour for throughput fields).
     """
     if "per_sec" in field or "cups" in field or "tflops" in field:
         return "higher"
     if ("latency" in field or "shed" in field or "degrad" in field
-            or "evict" in field
+            or "evict" in field or "loss" in field or "burn" in field
             or field.endswith(("_sec", "_seconds", "_s", "_bytes"))):
         return "lower"
     return "higher"

@@ -35,13 +35,32 @@ from mpi_and_open_mp_tpu.obs import report  # noqa: E402
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="analysis/trace_report.py")
-    p.add_argument("trace", help="MOMP_TRACE JSONL file to summarise")
+    p.add_argument("trace", help="MOMP_TRACE JSONL file to summarise "
+                   "(with --fleet: a fleet state DIRECTORY)")
     p.add_argument("--json", action="store_true",
                    help="emit the report as one JSON object")
     p.add_argument("--chrome", metavar="OUT",
                    help="write Chrome trace-event JSON (Perfetto-loadable) "
                    "here instead of reporting")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat the positional as a fleet state dir and "
+                   "merge every worker trace + sidecar into one timeline "
+                   "(delegates to analysis/fleet_report.py)")
+    p.add_argument("--router-trace", default=None, metavar="PATH",
+                   help="with --fleet: the parent's own MOMP_TRACE file")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        from analysis import fleet_report as fleet_mod
+
+        argv2 = [args.trace]
+        if args.router_trace:
+            argv2 += ["--router-trace", args.router_trace]
+        if args.chrome:
+            argv2 += ["--chrome", args.chrome]
+        if args.json:
+            argv2.append("--json")
+        return fleet_mod.main(argv2)
 
     try:
         records = report.load(args.trace)

@@ -249,6 +249,21 @@ def _batched_phase(batch: int, cups_single: float) -> dict:
     return fields
 
 
+def _phase_metrics_delta(key: str, before: dict) -> dict:
+    """Per-phase metric scoping (``obs.metrics.delta``): each opt-in
+    phase snapshots the registry at entry and publishes only the
+    movement IT caused, so ``--batch`` counters cannot bleed into the
+    ``--serve`` / ``--loadgen`` sub-objects when phases stack on one
+    bench line. The global cumulative snapshot still rides the line
+    unchanged (``metrics``)."""
+    from mpi_and_open_mp_tpu.obs import metrics as obs_metrics
+
+    if not obs_metrics.metrics_on():
+        return {}
+    return {f"{key}_phase_metrics":
+            obs_metrics.delta(before, obs_metrics.snapshot())}
+
+
 def _serve_phase(n: int) -> dict:
     """The serving-daemon latency phase (``--serve N``): a seeded
     mixed-shape burst of N requests through the supervised daemon
@@ -526,9 +541,11 @@ def _loadgen_phase(args) -> dict:
     recorded."""
     import tempfile
 
+    from mpi_and_open_mp_tpu.obs import telemetry as telemetry_mod
     from mpi_and_open_mp_tpu.ops.life_ops import life_step_numpy
     from mpi_and_open_mp_tpu.serve import (
-        SLO, ScenarioMix, ServePolicy, run_open_loop, saturation_knee)
+        SLO, ElasticityPolicy, ScenarioMix, ServePolicy, run_open_loop,
+        saturation_knee)
     from mpi_and_open_mp_tpu.serve.fleet import Fleet
 
     rates = [float(r) for r in str(args.loadgen).split(",") if r.strip()]
@@ -573,20 +590,27 @@ def _loadgen_phase(args) -> dict:
     with tempfile.TemporaryDirectory(prefix="momp-bench-loadgen-") as td:
         # -- (1) the saturation sweep: fresh fleet per rung ------------
         reports = []
+        rollups = []
+        burns = []
         bad = 0
         balanced = True
         for j, rate in enumerate(rates):
             fleet = Fleet(workers, policy,
                           wal_dir=os.path.join(td, f"rung{j}"),
-                          heartbeat_interval_s=0.01)
+                          heartbeat_interval_s=0.01,
+                          telemetry_interval_s=0.02)
             rep = run_open_loop(fleet, rate, duration, mix=mix, slo=slo,
                                 seed=17)
             reports.append(rep)
+            rollups.append(fleet.router.telemetry)
+            burns.append(fleet.burn)
             bad += parity_bad(fleet)
             balanced = balanced and rep.books["balanced"]
         knee = saturation_knee(reports)
         at_knee = next((r for r in reversed(reports) if r.slo_ok),
                        reports[0])
+        kroll = rollups[reports.index(at_knee)]
+        kburn = burns[reports.index(at_knee)]
         fields.update({
             "loadgen_knee_rps": knee["knee_rps"],
             "loadgen_breach_rps": knee["breach_rps"],
@@ -605,10 +629,46 @@ def _loadgen_phase(args) -> dict:
                 f"parity check failed on {bad} resolved boards/sessions "
                 "(saturation sweep)")
 
+        # Telemetry plane at the knee: the fleet rollup's merged-bucket
+        # quantiles must agree with the loadgen-side exact percentiles
+        # within the DECLARED histogram bucket error (adjacent-bucket
+        # tolerance — the acceptance gate for the shipped series), and
+        # the burn-rate peak at a met SLO is the recorded headroom.
+        ksum = kroll.summary() if kroll is not None else {}
+        fields.update({
+            "telemetry_snapshots": ksum.get("snapshots", 0),
+            "telemetry_rollup_rps": ksum.get("resolved_rps", 0.0),
+            "telemetry_rollup_p50_s": ksum.get("p50_s"),
+            "telemetry_rollup_p99_s": ksum.get("p99_s"),
+            "telemetry_rollup_p999_s": ksum.get("p999_s"),
+            "telemetry_bucket_rel_err": round(
+                telemetry_mod.BUCKET_REL_ERR, 6),
+            "telemetry_quantile_agree": (
+                kroll is not None and kroll.hist.count > 0
+                and kroll.hist.agrees(kroll.quantile(50), at_knee.p50_s)
+                and kroll.hist.agrees(kroll.quantile(99), at_knee.p99_s)),
+            "telemetry_snapshot_loss_frac": (
+                ksum.get("loss", {}).get("frac", 0.0)),
+            "loadgen_burn_rate_peak": (
+                kburn.summary()["burn_peak_long"]
+                if kburn is not None else None),
+        })
+
         # -- (2) the membership cycle at the knee rate -----------------
         cycle_rate = knee["knee_rps"] or rates[0]
         cfleet = Fleet(workers, policy, wal_dir=os.path.join(td, "cycle"),
-                       heartbeat_interval_s=0.01)
+                       heartbeat_interval_s=0.01,
+                       telemetry_interval_s=0.02,
+                       # The controller rides the cycle drill so its
+                       # verdicts land as recorded telemetry decisions.
+                       # Surplus is unreachable (p99 < 0 never holds), so
+                       # the controller can only ADD — the drill's single
+                       # scripted drain stays the only drain on the books.
+                       elasticity=ElasticityPolicy(
+                           slo_p99_s=slo.p99_s,
+                           slo_goodput_frac=slo.goodput_frac,
+                           min_workers=1, max_workers=workers + 2,
+                           surplus_p99_frac=0.0))
         drill: dict = {}
 
         def ev_wedge(fl):
@@ -688,6 +748,27 @@ def _loadgen_phase(args) -> dict:
             fields["loadgen_cycle_error"] = (
                 f"parity check failed on {cbad} resolved "
                 "boards/sessions (membership cycle)")
+
+        # The cycle drill's telemetry record: every controller verdict
+        # carries the burn-rate window values that triggered it, the
+        # wedge shows up as burn alerts, and the surviving workers lose
+        # ZERO snapshots (the drain flush ships every last interval).
+        csum = cfleet.router.telemetry.summary()
+        fields.update({
+            "telemetry_cycle_snapshots": csum["snapshots"],
+            "telemetry_cycle_loss_frac": csum["loss"]["frac"],
+            "telemetry_cycle_burn_alerts": (
+                cfleet.burn.summary()["burn_alerts"]
+                if cfleet.burn is not None else 0),
+            "telemetry_cycle_burn_peak": (
+                cfleet.burn.summary()["burn_peak_short"]
+                if cfleet.burn is not None else 0.0),
+            "telemetry_decisions": len(cfleet.decisions),
+            "loadgen_cycle_decisions": cfleet.decisions,
+            "telemetry_decisions_have_windows": all(
+                "burn_short" in d and "burn_long" in d
+                for d in cfleet.decisions),
+        })
     return fields
 
 
@@ -2161,12 +2242,14 @@ def _bench(args, state) -> int:
     batched = {}
     if args.batch:
         state["phase"] = "batch"
+        m0 = obs_metrics.snapshot()
         with obs_trace.span("bench.phase", phase="batch"):
             try:
                 batched = _batched_phase(args.batch, cups)
             except Exception as e:
                 batched = {"batch": args.batch,
                            "batched_error": f"{type(e).__name__}: {e}"[:200]}
+        batched.update(_phase_metrics_delta("batch", m0))
 
     # Autotune phase (opt-in via --autotune K): bounded measured tuning
     # pass or persisted-plan reuse; heuristic-vs-tuned A/B fields ride
@@ -2190,6 +2273,7 @@ def _bench(args, state) -> int:
         from mpi_and_open_mp_tpu.robust.preempt import Preempted
 
         state["phase"] = "serve"
+        m0 = obs_metrics.snapshot()
         with obs_trace.span("bench.phase", phase="serve"):
             try:
                 served = _serve_phase(args.serve)
@@ -2199,8 +2283,10 @@ def _bench(args, state) -> int:
                 served = {"serve_daemon_requests": args.serve,
                           "serve_daemon_error":
                           f"{type(e).__name__}: {e}"[:200]}
+        served.update(_phase_metrics_delta("serve", m0))
         if args.fleet:
             state["phase"] = "fleet"
+            m0 = obs_metrics.snapshot()
             with obs_trace.span("bench.phase", phase="fleet"):
                 try:
                     served.update(_fleet_phase(args.serve, args.fleet))
@@ -2210,6 +2296,7 @@ def _bench(args, state) -> int:
                     served.update({"fleet_workers": args.fleet,
                                    "fleet_error":
                                    f"{type(e).__name__}: {e}"[:200]})
+            served.update(_phase_metrics_delta("fleet", m0))
 
     # Elastic-fleet-under-load phase (opt-in via --loadgen R1,R2,..):
     # open-loop saturation sweep + the wedge->REJOIN->drain membership
@@ -2218,6 +2305,7 @@ def _bench(args, state) -> int:
         from mpi_and_open_mp_tpu.robust.preempt import Preempted
 
         state["phase"] = "loadgen"
+        m0 = obs_metrics.snapshot()
         with obs_trace.span("bench.phase", phase="loadgen"):
             try:
                 served.update(_loadgen_phase(args))
@@ -2227,6 +2315,7 @@ def _bench(args, state) -> int:
                 served.update({"loadgen_rates": args.loadgen,
                                "loadgen_error":
                                f"{type(e).__name__}: {e}"[:200]})
+        served.update(_phase_metrics_delta("loadgen", m0))
 
     # Resident-session phase (opt-in via --sessions S): the device-
     # resident vs ship-every-call A/B through the session pool. Same
@@ -2235,6 +2324,7 @@ def _bench(args, state) -> int:
         from mpi_and_open_mp_tpu.robust.preempt import Preempted
 
         state["phase"] = "sessions"
+        m0 = obs_metrics.snapshot()
         with obs_trace.span("bench.phase", phase="sessions"):
             try:
                 served.update(_sessions_phase(args.sessions))
@@ -2244,6 +2334,7 @@ def _bench(args, state) -> int:
                 served.update({"session_count": args.sessions,
                                "session_error":
                                f"{type(e).__name__}: {e}"[:200]})
+        served.update(_phase_metrics_delta("sessions", m0))
 
     # Sparse active-tile A/B (opt-in via --sparse-ab K): the mostly-dead
     # big-board scaling axis. Same failure contract as the other opt-in

@@ -20,6 +20,15 @@ when off:
     ``:recovered`` ladder falls, checkpoint bytes/durations. On by
     default (host-side dict ops); ``MOMP_METRICS=0`` no-ops every
     recorder. ``bench.py`` publishes ``snapshot()`` on its JSON line.
+``telemetry``
+    The fleet time-series layer over the registry: bounded per-worker
+    snapshot rings (periodic deltas, paired mono/wall clock stamps),
+    fixed-bucket latency histograms with p50/p99/p999 readout and a
+    DECLARED bucket error, the multi-window SLO burn-rate monitor the
+    elasticity controller's decisions record, and the length-prefixed
+    CRC-framed sidecar stream worker subprocesses ship snapshots over
+    (a kill -9 loses at most one partial frame, and the loss is
+    counted). ``MOMP_TELEMETRY=0`` switches the plane off.
 ``report``
     Pure-host analysis of a trace file: per-phase breakdown, α+βn fit
     over ring-hop transfer spans, recovery/retrace summary, and a Chrome
@@ -39,4 +48,5 @@ when off:
     accounting.
 """
 
-from mpi_and_open_mp_tpu.obs import ledger, metrics, trace  # noqa: F401
+from mpi_and_open_mp_tpu.obs import (  # noqa: F401
+    ledger, metrics, telemetry, trace)

@@ -11,7 +11,12 @@ Keys are ``(name, sorted label items)``; :func:`snapshot` renders them
 ``name{k=v,...}`` — the flat, diffable form ``bench.py`` publishes as
 the ``metrics`` sub-object of its JSON line. Histograms keep
 count/total/min/max (no buckets: the consumers here want "how many, how
-long altogether, worst case", not quantiles).
+long altogether, worst case", not quantiles — latency SERIES that need
+p50/p99/p999 live in ``obs.telemetry.LatencyHist`` on top of this
+registry). Label cardinality is capped per metric name
+(``MOMP_METRICS_MAX_LABELSETS``, default 256): a high-cardinality label
+(per-session ids under loadgen) stops growing the registry at the cap
+and ticks ``metrics.dropped_labels`` instead.
 
 What lands here (the instrumented layers):
 
@@ -36,11 +41,44 @@ import os
 import threading
 
 _ENV = "MOMP_METRICS"
+_ENV_MAX_LABELSETS = "MOMP_METRICS_MAX_LABELSETS"
+
+#: Overflow counter ticked when the cardinality guard drops a record.
+DROPPED_LABELS = "metrics.dropped_labels"
 
 _LOCK = threading.Lock()
 _COUNTERS: dict[tuple, float] = {}
 _GAUGES: dict[tuple, float] = {}
 _HISTS: dict[tuple, list[float]] = {}  # [count, total, min, max]
+_LABELSETS: dict[str, int] = {}  # distinct label sets seen per name
+
+
+def max_labelsets() -> int:
+    """Distinct label sets admitted per metric name before the guard
+    drops new ones (``MOMP_METRICS_MAX_LABELSETS``, default 256)."""
+    try:
+        v = int(os.environ.get(_ENV_MAX_LABELSETS, "256"))
+    except ValueError:
+        return 256
+    return v if v > 0 else 256
+
+
+def _admit(k: tuple, store: dict) -> bool:
+    """Cardinality guard, called under ``_LOCK``: an EXISTING key always
+    updates; a new key is admitted only while its metric name is under
+    the label-set cap. Without this, one per-session label under loadgen
+    grows the registry with the traffic — unbounded resident memory and
+    a snapshot() that swamps the bench line. Drops tick
+    :data:`DROPPED_LABELS` (itself label-free, so never droppable)."""
+    if k in store:
+        return True
+    name = k[0]
+    if _LABELSETS.get(name, 0) >= max_labelsets():
+        dk = (DROPPED_LABELS, ())
+        _COUNTERS[dk] = _COUNTERS.get(dk, 0) + 1
+        return False
+    _LABELSETS[name] = _LABELSETS.get(name, 0) + 1
+    return True
 
 
 def metrics_on() -> bool:
@@ -60,15 +98,18 @@ def inc(name: str, value: float = 1, **labels) -> None:
         return
     k = _key(name, labels)
     with _LOCK:
-        _COUNTERS[k] = _COUNTERS.get(k, 0) + value
+        if _admit(k, _COUNTERS):
+            _COUNTERS[k] = _COUNTERS.get(k, 0) + value
 
 
 def gauge(name: str, value: float, **labels) -> None:
     """Set a last-value-wins gauge."""
     if not metrics_on():
         return
+    k = _key(name, labels)
     with _LOCK:
-        _GAUGES[_key(name, labels)] = value
+        if _admit(k, _GAUGES):
+            _GAUGES[k] = value
 
 
 def observe(name: str, value: float, **labels) -> None:
@@ -81,6 +122,8 @@ def observe(name: str, value: float, **labels) -> None:
     with _LOCK:
         h = _HISTS.get(k)
         if h is None:
+            if not _admit(k, _HISTS):
+                return
             _HISTS[k] = [1, value, value, value]
         else:
             h[0] += 1
@@ -117,9 +160,42 @@ def snapshot() -> dict:
         }
 
 
+def delta(before: dict, after: dict) -> dict:
+    """The registry movement BETWEEN two :func:`snapshot` calls, in
+    snapshot shape — per-phase metric scoping for ``bench.py``: each
+    opt-in phase snapshots at entry and publishes only what IT moved,
+    so ``--batch`` counters cannot bleed into the ``--serve`` /
+    ``--loadgen`` sub-objects. Counters and histogram count/total
+    subtract (zero movement drops out); gauges are last-value-wins so
+    the phase reports those it TOUCHED at their ``after`` value;
+    histogram min/max cannot be un-merged and honestly report the
+    window's ``after`` values only when the count moved."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    b, a = before.get("counters", {}), after.get("counters", {})
+    for key, v in a.items():
+        moved = v - b.get(key, 0)
+        if moved:
+            out["counters"][key] = moved
+    bg, ag = before.get("gauges", {}), after.get("gauges", {})
+    for key, v in ag.items():
+        if key not in bg or bg[key] != v:
+            out["gauges"][key] = v
+    bh, ah = before.get("histograms", {}), after.get("histograms", {})
+    for key, h in ah.items():
+        prev = bh.get(key, {"count": 0, "total": 0.0})
+        moved = h["count"] - prev["count"]
+        if moved:
+            out["histograms"][key] = {
+                "count": moved, "total": h["total"] - prev["total"],
+                "min": h["min"], "max": h["max"],
+            }
+    return out
+
+
 def reset() -> None:
     """Empty the registry (tests; fresh bench phases)."""
     with _LOCK:
         _COUNTERS.clear()
         _GAUGES.clear()
         _HISTS.clear()
+        _LABELSETS.clear()

@@ -28,7 +28,10 @@ import json
 def load(path: str) -> list[dict]:
     """Parse one record per non-blank line; raise ``ValueError`` naming
     the first malformed line (a truncated tail from a killed process is
-    a real signal, not something to paper over)."""
+    a real signal, not something to paper over). A well-formed JSON
+    object WITHOUT a ``kind`` field is a header line (external tooling
+    prepends them), not corruption: it is skipped, so an empty or
+    header-only file reports zero records instead of erroring."""
     records = []
     with open(path) as fd:
         for lineno, line in enumerate(fd, 1):
@@ -40,9 +43,11 @@ def load(path: str) -> list[dict]:
             except json.JSONDecodeError as e:
                 raise ValueError(
                     f"{path}:{lineno}: not a JSON record ({e.msg})") from e
-            if not isinstance(rec, dict) or "kind" not in rec:
+            if not isinstance(rec, dict):
                 raise ValueError(
-                    f"{path}:{lineno}: record without a 'kind' field")
+                    f"{path}:{lineno}: not a JSON object record")
+            if "kind" not in rec:
+                continue  # header line
             records.append(rec)
     return records
 
@@ -60,7 +65,7 @@ def _phase_breakdown(records: list[dict]) -> dict:
     phases: dict[str, dict] = {}
     for s in spans:
         ph = phases.setdefault(
-            s["name"], {"count": 0, "total_s": 0.0, "errors": 0})
+            s.get("name", "?"), {"count": 0, "total_s": 0.0, "errors": 0})
         ph["count"] += 1
         ph["total_s"] += s.get("dur", 0.0)
         if "error" in s:
@@ -183,7 +188,7 @@ def _track_of(rec: dict, by_id: dict) -> int:
     while True:
         parent = cur.get("parent")
         if parent is None or parent not in by_id or parent in seen:
-            return cur["id"]
+            return cur.get("id", 0)
         seen.add(parent)
         cur = by_id[parent]
 

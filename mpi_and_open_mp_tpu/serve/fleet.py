@@ -40,13 +40,16 @@ import time
 
 import numpy as np
 
+from mpi_and_open_mp_tpu.obs import metrics as obs_metrics
+from mpi_and_open_mp_tpu.obs import telemetry as telemetry_mod
+from mpi_and_open_mp_tpu.obs import trace as obs_trace
 from mpi_and_open_mp_tpu.serve import policy as policy_mod
 from mpi_and_open_mp_tpu.serve import wal as wal_mod
 from mpi_and_open_mp_tpu.serve.daemon import ServingDaemon, _parse_shapes
 from mpi_and_open_mp_tpu.serve.policy import ServePolicy, percentile
-from mpi_and_open_mp_tpu.serve.queue import DONE, Ticket
+from mpi_and_open_mp_tpu.serve.queue import DONE, SHED, Ticket
 from mpi_and_open_mp_tpu.serve.router import (
-    DEFAULT_MISS_K, DEFAULT_VNODES, FleetRouter)
+    DEFAULT_MISS_K, DEFAULT_VNODES, FleetRollup, FleetRouter)
 from mpi_and_open_mp_tpu.utils import checkpoint as checkpoint_mod
 
 SPOOL_SCHEMA = "momp-fleet-spool/1"
@@ -99,6 +102,8 @@ class Fleet:
                  steal: bool = True,
                  elasticity: policy_mod.ElasticityPolicy | None = None,
                  elastic_window_s: float = 1.0,
+                 telemetry: bool | None = None,
+                 telemetry_interval_s: float | None = None,
                  vnodes: int = DEFAULT_VNODES, seed: int = 0,
                  clock=time.monotonic, sleep=time.sleep):
         if n_workers < 1:
@@ -122,6 +127,33 @@ class Fleet:
         self.controller = (policy_mod.ElasticController(elasticity)
                            if elasticity is not None else None)
         self._elastic_window_s = float(elastic_window_s)
+        #: The telemetry plane: per-worker snapshot recorders shipped
+        #: into the router's FleetRollup on the shared post-round beat
+        #: (snapshots piggyback the heartbeat — a worker alive enough to
+        #: beat is alive enough to report), plus the multi-window SLO
+        #: burn-rate monitor whose window values every scale/drain
+        #: decision records. ``MOMP_TELEMETRY=0`` (or telemetry=False)
+        #: turns the whole plane off.
+        self._telemetry_on = (telemetry_mod.telemetry_on()
+                              if telemetry is None else bool(telemetry))
+        self._telemetry_interval_s = (
+            telemetry_mod.snapshot_interval_s()
+            if telemetry_interval_s is None else float(telemetry_interval_s))
+        epol = elasticity or policy_mod.ElasticityPolicy()
+        self.burn = telemetry_mod.BurnRateMonitor(
+            slo_p99_s=epol.slo_p99_s, goodput_frac=epol.slo_goodput_frac,
+            short_window_s=self._elastic_window_s / 4,
+            long_window_s=self._elastic_window_s,
+        ) if self._telemetry_on else None
+        #: Recorded elasticity decisions, each carrying the burn-rate
+        #: window values that triggered it — the queryable record the
+        #: ISSUE's "every decision explainable from recorded data" asks
+        #: for (also emitted as ``serve.fleet.scale`` trace events).
+        self.decisions: list[dict] = []
+        self._wtel: dict[int, telemetry_mod.WorkerTelemetry] = {}
+        self._tel_seen: dict[int, set] = {}
+        self._tel_counts: dict[int, dict] = {}
+        self._door_seen = 0
         self.handles: list[WorkerHandle] = []
         for i in range(n_workers):
             wal_path = (os.path.join(wal_dir, f"worker{i}.wal")
@@ -240,8 +272,21 @@ class Fleet:
                and now - t.resolved_at <= window]
         p99 = percentile(lat, 99) if lat else 0.0
         live = self.router.live_workers()
+        depth = self.pending()
         verdict = self.controller.observe(
-            p99_s=p99, depth=self.pending(), workers=len(live))
+            p99_s=p99, depth=depth, workers=len(live))
+        if verdict is not None:
+            # Every scale/drain verdict lands as recorded telemetry
+            # WITH the burn-rate window values that triggered it — the
+            # decision must be explainable from the recorded data alone.
+            decision = {
+                "action": verdict, "p99_s": round(p99, 6), "depth": depth,
+                "workers": len(live), "mono": round(now, 6),
+                **(self.burn.windows(now) if self.burn is not None else {}),
+            }
+            self.decisions.append(decision)
+            obs_metrics.inc("serve.fleet.scale_decisions", action=verdict)
+            obs_trace.event("serve.fleet.scale", **decision)
         if verdict == policy_mod.SCALE_ADD:
             self.spawn_worker()
         elif verdict == policy_mod.SCALE_DRAIN and len(live) > 1:
@@ -286,9 +331,80 @@ class Fleet:
         self.router.check_health(now)
         if self._steal_enabled:
             self.router.steal(self._clock(), defer=True)
+        if self._telemetry_on:
+            # Snapshot shipping rides the same post-round beat: the
+            # telemetry tick runs BEFORE the elasticity tick, so a
+            # burn-rate alert is on the record before any decision it
+            # triggers (the merged timeline shows cause, then action).
+            self._telemetry_tick(now)
         if self.controller is not None:
             self._autoscale(now)
         return n
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _worker_telemetry(self, h: WorkerHandle):
+        """The recorder for one handle LIFETIME (a rejoin's fresh handle
+        gets a fresh series under the same worker index)."""
+        wt = self._wtel.get(id(h))
+        if wt is None:
+            wt = telemetry_mod.WorkerTelemetry(
+                h.index, interval_s=self._telemetry_interval_s)
+            self._wtel[id(h)] = wt
+            self._tel_seen[id(h)] = set()
+            self._tel_counts[id(h)] = {"resolved": 0, "shed": 0}
+        return wt
+
+    def _telemetry_tick(self, now: float, *, force: bool = False) -> None:
+        """Ship every due worker's snapshot into the router's rollup and
+        feed the burn monitor the interval's good/bad counts. Interval-
+        gated per worker; ``force`` flushes everyone (the end-of-run
+        sample that makes surviving workers lose zero telemetry)."""
+        good = bad = 0
+        sampled = False
+        for h in self.handles:
+            if h.wedged or h.drained:
+                continue  # frozen books; the last live sample stands
+            wt = self._worker_telemetry(h)
+            if not (force or wt.due(now)):
+                continue
+            seen = self._tel_seen[id(h)]
+            counts = self._tel_counts[id(h)]
+            for t in h.daemon.queue.tickets():
+                if t.id in seen:
+                    continue
+                if t.state == DONE:
+                    seen.add(t.id)
+                    counts["resolved"] += 1
+                    wt.observe_latency(t.latency_s)
+                    if self.burn is not None and \
+                            self.burn.is_bad(t.latency_s):
+                        bad += 1
+                    else:
+                        good += 1
+                elif (t.state == SHED
+                      and t.reason != policy_mod.SHED_REHOMED):
+                    # A real shed spends error budget; a re-homed ticket
+                    # is a move, not an outcome — it resolves (or sheds)
+                    # at its final owner and is judged there.
+                    seen.add(t.id)
+                    counts["shed"] += 1
+                    bad += 1
+            snap = wt.sample(now, {
+                **counts, "depth": h.daemon.queue.depth(),
+            }, force=force)
+            if snap is not None:
+                self.router.telemetry.ingest(snap)
+                sampled = True
+        if self.burn is None or not sampled:
+            return
+        door = sum(self.router.door_shed.values())
+        bad += door - self._door_seen
+        self._door_seen = door
+        win = self.burn.observe(now, good, bad)
+        if win.pop("alert_edge", False):
+            obs_metrics.inc("serve.fleet.burn_alerts")
+            obs_trace.event("serve.fleet.burn", mono=round(now, 6), **win)
 
     def pending(self) -> int:
         return (sum(h.daemon.queue.depth() for h in self.handles)
@@ -309,6 +425,11 @@ class Fleet:
                 raise RuntimeError(
                     f"fleet failed to drain within {timeout_s}s "
                     f"({self.pending()} tickets pending)")
+        if self._telemetry_on:
+            # Final forced flush: every surviving worker's last interval
+            # ships, so the rollup loses zero telemetry from survivors
+            # (dead workers lose at most their final interval, counted).
+            self._telemetry_tick(self._clock(), force=True)
         for h in self.handles:
             if h.daemon._wal is not None and not h.wedged:
                 h.daemon._wal.sync()
@@ -373,11 +494,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="each worker gates every resolved board "
                    "bit-exact against the NumPy oracle — including the "
                    "re-homed tickets on recovery workers")
+    p.add_argument("--slo-p99", type=float, default=0.25, metavar="S",
+                   help="latency SLO threshold the telemetry plane "
+                   "classifies resolved tickets against (default "
+                   "%(default)s s)")
     # Internal: run as one fleet worker over a spool file.
     p.add_argument("--worker-main", type=int, default=None, metavar="I",
                    help=argparse.SUPPRESS)
     p.add_argument("--spool", default=None, help=argparse.SUPPRESS)
     p.add_argument("--wal", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--telemetry-sidecar", default=None,
+                   help=argparse.SUPPRESS)
     return p
 
 
@@ -404,6 +531,40 @@ def _worker_main(args) -> int:
     daemon.adopt(rehomed)
     for e in fresh:
         daemon.submit(e["board"], e["steps"], session=e.get("session"))
+
+    shipper = None
+    if args.telemetry_sidecar and telemetry_mod.telemetry_on():
+        # The sidecar stream: a daemon thread frames periodic snapshots
+        # into the per-worker file the parent merges post-run. A kill -9
+        # stops the writer mid-frame at worst — the CRC framing bounds
+        # the loss to this worker's final interval, and the parent
+        # COUNTS it (`telemetry.loss`).
+        seen: set = set()
+        counts = {"resolved": 0, "shed": 0, "good": 0, "bad": 0}
+
+        def _sample():
+            new_lat = []
+            for t in daemon.queue.tickets():
+                if t.id in seen:
+                    continue
+                if t.state == DONE:
+                    seen.add(t.id)
+                    counts["resolved"] += 1
+                    new_lat.append(t.latency_s)
+                    if t.latency_s > args.slo_p99:
+                        counts["bad"] += 1
+                    else:
+                        counts["good"] += 1
+                elif t.state == SHED:
+                    seen.add(t.id)
+                    counts["shed"] += 1
+                    if t.reason != policy_mod.SHED_REHOMED:
+                        counts["bad"] += 1
+            return (dict(counts, depth=daemon.queue.depth()), new_lat)
+
+        shipper = telemetry_mod.SnapshotShipper(
+            args.telemetry_sidecar, idx, _sample).start()
+
     t0 = time.perf_counter()
     try:
         daemon.serve(watch_signals=True)
@@ -411,6 +572,9 @@ def _worker_main(args) -> int:
         print(json.dumps({"worker": idx,
                           "error": f"{type(e).__name__}: {e}"[:300]}))
         return 1
+    finally:
+        if shipper is not None:
+            shipper.stop()
     rec = {"worker": idx, "wall_sec": round(time.perf_counter() - t0, 4),
            **{k: v for k, v in daemon.summary().items() if k != "engines"}}
     if args.verify:
@@ -433,10 +597,20 @@ def _spawn_worker(args, idx: int, spool_path: str, wal_path: str,
            "--max-wait", str(args.max_wait),
            "--timeout", str(args.timeout),
            "--max-padding-frac", str(args.max_padding_frac),
-           "--seed", str(args.seed)]
+           "--seed", str(args.seed),
+           "--slo-p99", str(args.slo_p99)]
     if args.verify:
         cmd.append("--verify")
     env = dict(os.environ)
+    stem = out_path[:-4] if out_path.endswith(".out") else out_path
+    if telemetry_mod.telemetry_on():
+        cmd += ["--telemetry-sidecar", stem + ".telemetry.bin"]
+    if obs_trace.enabled():
+        # Per-worker trace sink: every subprocess appends to its OWN
+        # JSONL next to its stdout, so the merged Perfetto timeline
+        # (analysis/fleet_report.py) gets one track per worker without
+        # interleaved writes to the parent's file.
+        env["MOMP_TRACE"] = stem + ".trace.jsonl"
     if strip_chaos:
         # Recovery workers run clean by the same convention as the
         # in-process ladder's chaos.suppressed(): the fault that killed
@@ -516,6 +690,47 @@ def main(argv=None) -> int:
     lines = {i: _read_worker_line(os.path.join(state_dir, f"worker{i}.out"))
              for i in range(n)}
 
+    # -- telemetry rollup: merge every worker's sidecar stream ---------
+    tel_on = telemetry_mod.telemetry_on()
+    rollup = FleetRollup() if tel_on else None
+    burn = (telemetry_mod.BurnRateMonitor(slo_p99_s=args.slo_p99)
+            if tel_on else None)
+    scale_decisions: list[dict] = []
+
+    def _ingest_sidecar(stem: str, worker_key=None) -> list[dict]:
+        """Fold one sidecar file into the rollup; returns its snapshots
+        (for the burn feed). Truncated tail frames charge loss."""
+        rep = telemetry_mod.read_frames(stem + ".telemetry.bin")
+        rollup.truncated += rep["truncated"]
+        for s in rep["snapshots"]:
+            rollup.ingest(s, worker=worker_key)
+        return rep["snapshots"]
+
+    def _feed_burn(streams: list[list[dict]]) -> None:
+        """Replay the streams' good/bad counter deltas into the parent
+        burn monitor on the shared WALL timeline (each worker stamps
+        wall alongside mono — the clock-alignment exchange). Deltas
+        from ALL streams merge-sort by wall first: the monitor's window
+        pruning wants a monotone feed."""
+        feed = []
+        for snaps in streams:
+            pg = pb = 0
+            for s in snaps:
+                c = s.get("counters") or {}
+                g, b = int(c.get("good", 0)), int(c.get("bad", 0))
+                feed.append((float(s["wall"]), g - pg, b - pb))
+                pg, pb = g, b
+        for wall_t, g, b in sorted(feed):
+            win = burn.observe(wall_t, g, b)
+            if win.pop("alert_edge", False):
+                obs_metrics.inc("serve.fleet.burn_alerts")
+                obs_trace.event("serve.fleet.burn",
+                                wall=round(wall_t, 6), **win)
+
+    if tel_on:
+        _feed_burn([_ingest_sidecar(os.path.join(state_dir, f"worker{i}"))
+                    for i in range(n)])
+
     # -- failure domain: replay each dead worker's WAL, re-home --------
     victims = [i for i, rc in rcs.items() if rc != 0]
     t_kill = time.perf_counter()
@@ -541,6 +756,27 @@ def main(argv=None) -> int:
             key = affinity_key(e.get("session"), e.get("id"))
             by_target.setdefault(ring.lookup(key), []).append(e)
         rehomed += len(rep.pending)
+        if tel_on:
+            # The kill lands on the record BEFORE the autoscale verb:
+            # the victim's lost pending set spends error budget NOW, the
+            # burn event carries the window values, and only then does
+            # the scale decision (spawn recovery capacity) follow — the
+            # merged timeline shows cause, then action.
+            now_wall = time.time()
+            win = burn.observe(now_wall, 0, len(rep.pending))
+            if win.pop("alert_edge", False):
+                obs_metrics.inc("serve.fleet.burn_alerts")
+            obs_trace.event("serve.fleet.burn", wall=round(now_wall, 6),
+                            worker=v, pending=len(rep.pending), **win)
+            decision = {
+                "action": "add", "reason": "worker-death", "worker": v,
+                "pending": len(rep.pending),
+                "wall": round(time.time(), 6),
+                **burn.windows(now_wall),
+            }
+            scale_decisions.append(decision)
+            obs_metrics.inc("serve.fleet.scale_decisions", action="add")
+            obs_trace.event("serve.fleet.scale", **decision)
         for tgt, group in by_target.items():
             spool_path = os.path.join(state_dir,
                                       f"worker{tgt}.rehome{v}.spool")
@@ -554,6 +790,12 @@ def main(argv=None) -> int:
                 out, strip_chaos=True)
             recovery_rcs.append(proc.wait())
             recovery_lines.append(_read_worker_line(out) or {})
+            if tel_on:
+                # The recovery worker re-uses index `tgt` but is a new
+                # lifetime: its stream rolls up under its own key.
+                _feed_burn([_ingest_sidecar(
+                    os.path.join(state_dir, f"worker{tgt}.rehome{v}"),
+                    worker_key=f"{tgt}.rehome{v}")])
     recovery_s = time.perf_counter() - t_kill if victims else 0.0
     wall = time.perf_counter() - t_start
 
@@ -596,6 +838,13 @@ def main(argv=None) -> int:
         rec["verified"] = verified
         rec["rehomed_parity"] = all(
             ln.get("verified", False) for ln in recovery_lines)
+    if tel_on:
+        rec["telemetry"] = {
+            **rollup.summary(),
+            **burn.summary(),
+            "clock_offsets": rollup.clock_offsets(),
+            "decisions": scale_decisions,
+        }
     print(json.dumps(rec))
     ok = (rec["books_balance"]
           and all(rc == 0 for rc in recovery_rcs)

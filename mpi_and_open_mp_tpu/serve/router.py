@@ -148,6 +148,119 @@ def affinity_key(session: str | None, ticket_id: int | None = None) -> str:
     return f"ticket/{ticket_id if ticket_id is not None else 0}"
 
 
+class FleetRollup:
+    """Merge per-worker telemetry series into fleet-wide rates/quantiles.
+
+    The ingestion-side twin of :class:`~mpi_and_open_mp_tpu.obs.
+    telemetry.WorkerTelemetry`: each shipped snapshot folds its latency-
+    histogram DELTA into one fleet histogram (quantiles over the merged
+    buckets — no raw samples cross the wire) and supersedes the worker's
+    cumulative counters. Loss accounting is per worker by sequence
+    number: ``expected = max_seq + 1`` per worker lifetime, anything
+    missing (ring eviction before shipping, a frame lost to a kill)
+    is ``lost`` — so ``loss()`` states exactly how much of the series
+    the rollup never saw, instead of silently summing what arrived.
+    """
+
+    def __init__(self, bounds=None):
+        from mpi_and_open_mp_tpu.obs import telemetry as telemetry_mod
+
+        self.hist = telemetry_mod.LatencyHist(
+            bounds if bounds is not None else telemetry_mod.DEFAULT_BOUNDS)
+        #: worker → {"seq": last seq, "received": n, "counters": {...},
+        #: "first_mono"/"last_mono"/"last_wall": clock stamps}.
+        self.workers: dict[int, dict] = {}
+        self.snapshots = 0
+        self.rejected = 0
+        #: Truncated sidecar frames folded in by the CLI reader — each
+        #: is at most one lost interval, charged to loss() below.
+        self.truncated = 0
+
+    def ingest(self, snap: dict, *, worker=None) -> bool:
+        """Fold one snapshot; False (and counted) on a schema mismatch.
+        Out-of-order arrival is fine — seq gaps, not order, are loss.
+        ``worker`` overrides the stream key: a recovery worker re-uses a
+        surviving INDEX but restarts its sequence numbers, so its stream
+        must roll up under its own key or the seq-gap loss accounting
+        would read the restart as loss."""
+        from mpi_and_open_mp_tpu.obs import telemetry as telemetry_mod
+
+        if (not isinstance(snap, dict)
+                or snap.get("v") != telemetry_mod.SNAPSHOT_SCHEMA):
+            self.rejected += 1
+            return False
+        w = int(snap["worker"]) if worker is None else worker
+        st = self.workers.setdefault(w, {
+            "seq": -1, "received": 0, "counters": {},
+            "first_mono": float(snap["mono"]),
+            "last_mono": float(snap["mono"]),
+            "last_wall": float(snap["wall"]),
+        })
+        st["received"] += 1
+        if snap["seq"] > st["seq"]:
+            st["seq"] = int(snap["seq"])
+            st["counters"] = dict(snap.get("counters") or {})
+            st["last_mono"] = float(snap["mono"])
+            st["last_wall"] = float(snap["wall"])
+        st["first_mono"] = min(st["first_mono"], float(snap["mono"]))
+        self.hist.merge_counts(snap.get("hist") or {})
+        self.snapshots += 1
+        return True
+
+    def counter(self, name: str) -> float:
+        """Fleet-wide sum of a cumulative counter's latest value."""
+        return sum(st["counters"].get(name, 0)
+                   for st in self.workers.values())
+
+    def rate(self, name: str) -> float:
+        """Fleet-wide rate: the summed counter over the widest
+        first→last snapshot span any worker covered (one shared clock
+        in-process; per-process monotonic spans are still each worker's
+        own honest denominator cross-process)."""
+        span = max((st["last_mono"] - st["first_mono"]
+                    for st in self.workers.values()), default=0.0)
+        if span <= 0:
+            return 0.0
+        return self.counter(name) / span
+
+    def quantile(self, q: float) -> float:
+        return self.hist.quantile(q)
+
+    def loss(self) -> dict:
+        """Snapshot-loss accounting: per-worker seq gaps plus truncated
+        sidecar frames, over everything the workers ever numbered."""
+        expected = sum(st["seq"] + 1 for st in self.workers.values())
+        received = sum(st["received"] for st in self.workers.values())
+        lost = max(expected - received, 0) + self.truncated
+        expected += self.truncated
+        return {
+            "expected": expected, "received": received, "lost": lost,
+            "truncated": self.truncated,
+            "frac": round(lost / expected, 6) if expected else 0.0,
+        }
+
+    def clock_offsets(self) -> dict[int, float]:
+        """Per-worker monotonic→wall offsets from the latest heartbeat
+        exchange pair — the alignment the merged timeline applies."""
+        return {w: round(st["last_wall"] - st["last_mono"], 6)
+                for w, st in self.workers.items()}
+
+    def summary(self) -> dict:
+        h = self.hist.to_dict()
+        return {
+            "workers": sorted(self.workers, key=str),
+            "snapshots": self.snapshots,
+            "rejected": self.rejected,
+            "resolved": self.counter("resolved"),
+            "shed": self.counter("shed"),
+            "resolved_rps": round(self.rate("resolved"), 3),
+            "p50_s": h["p50_s"], "p99_s": h["p99_s"],
+            "p999_s": h["p999_s"],
+            "hist_count": h["count"],
+            "loss": self.loss(),
+        }
+
+
 class FleetRouter:
     """The fault-isolating front of a worker fleet.
 
@@ -178,6 +291,10 @@ class FleetRouter:
         self.heartbeat_miss_k = int(heartbeat_miss_k)
         self._rollup = policy_mod.rollup(
             w.daemon.policy for w in self.live_workers())
+        #: The fleet-wide telemetry aggregator: the fleet loop ships
+        #: each worker's snapshots here (in-process piggybacked on the
+        #: heartbeat; cross-process read back from the sidecar streams).
+        self.telemetry = FleetRollup()
         # Door accounting: submissions the ROUTER refused before any
         # worker saw them (fleet-wide budget breach).
         self.door_shed: dict[str, int] = {}

@@ -320,9 +320,12 @@ def test_recovery_log_ring_buffer_cap():
     log = guards.recovery_log()
     assert len(log) == guards.RECOVERY_LOG_CAP == 256
     assert log[0] == "s44" and log[-1] == "s299"  # oldest dropped first
-    # Counts in the registry stay exact even past the cap.
-    assert sum(metrics.get("recovery", stamp=f"s{i}")
-               for i in range(300)) == 300
+    # The registry's own cardinality guard caps distinct stamps at
+    # max_labelsets(); the overflow is COUNTED, never silent — 300
+    # recoveries are still 300 recoveries on the books.
+    kept = sum(metrics.get("recovery", stamp=f"s{i}") for i in range(300))
+    assert kept == metrics.max_labelsets() == 256
+    assert kept + metrics.get(metrics.DROPPED_LABELS) == 300
     guards.clear_recovery_log()  # the pre-obs alias keeps working
     assert guards.recovery_log() == []
 

@@ -1,0 +1,561 @@
+"""Fleet telemetry plane: histograms, snapshot rings, burn rate, sidecar
+framing, the router rollup, and the merged fleet timeline.
+
+The contract under test: quantiles read off the fixed-bucket histogram
+sit within the DECLARED bucket error of the exact sample quantiles; the
+snapshot ring is bounded and counts its own evictions; the burn-rate
+monitor trips on a real incident (both windows over budget) and is
+edge-triggered; the CRC-framed sidecar stream soft-lands on a killed
+writer's truncated tail; the rollup's loss accounting states exactly
+what never arrived; and an in-process Fleet records every elasticity
+decision with the burn windows that triggered it.
+"""
+
+import json
+import math
+import os
+import struct
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mpi_and_open_mp_tpu.obs import metrics, telemetry, trace
+from mpi_and_open_mp_tpu.serve.fleet import Fleet
+from mpi_and_open_mp_tpu.serve.policy import (
+    ElasticityPolicy, ServePolicy, percentile)
+from mpi_and_open_mp_tpu.serve.router import FleetRollup
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# -- LatencyHist -----------------------------------------------------------
+
+
+def test_hist_quantiles_within_declared_bucket_error():
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=-2.0, sigma=1.0, size=2000).tolist()
+    h = telemetry.LatencyHist()
+    for v in samples:
+        h.observe(v)
+    assert h.count == len(samples)
+    for q in (50, 99, 99.9):
+        exact = percentile(samples, q)
+        est = h.quantile(q)
+        # The estimate is the holding bucket's upper edge: never below
+        # the exact value's bucket, at most one ratio above it.
+        assert h.agrees(est, exact), (q, est, exact)
+        assert est >= exact * (1 - 1e-9)
+        assert est <= exact * telemetry.BUCKET_RATIO * (1 + 1e-9)
+
+
+def test_hist_empty_overflow_and_nan():
+    h = telemetry.LatencyHist()
+    assert h.quantile(99) == 0.0
+    h.observe(float("nan"))
+    assert h.count == 0
+    h.observe(1e6)  # past the last edge: overflow bucket, readout = max
+    assert h.quantile(99) == 1e6
+    assert h.counts[-1] == 1
+
+
+def test_hist_merge_counts_equals_direct_observation():
+    rng = np.random.default_rng(3)
+    a, b = telemetry.LatencyHist(), telemetry.LatencyHist()
+    whole = telemetry.LatencyHist()
+    for i, v in enumerate(rng.exponential(0.1, size=400)):
+        (a if i % 2 else b).observe(v)
+        whole.observe(v)
+    merged = telemetry.LatencyHist()
+    merged.merge_counts(a.snapshot_counts(), total=a.total,
+                        vmin=a.vmin, vmax=a.vmax)
+    # Sparse form too — what actually ships in snapshots.
+    sparse = {str(i): n for i, n in enumerate(b.counts) if n}
+    merged.merge_counts(sparse, total=b.total, vmin=b.vmin, vmax=b.vmax)
+    assert merged.counts == whole.counts
+    assert merged.count == whole.count
+    assert math.isclose(merged.total, whole.total)
+    for q in (50, 99):
+        assert merged.quantile(q) == whole.quantile(q)
+
+
+# -- WorkerTelemetry -------------------------------------------------------
+
+
+def test_worker_ring_bounded_and_counts_evictions():
+    wt = telemetry.WorkerTelemetry(0, interval_s=0.01, capacity=4)
+    for k in range(10):
+        snap = wt.sample(k * 1.0, {"resolved": k}, force=True)
+        assert snap is not None and snap["seq"] == k
+    assert len(wt.series()) == 4
+    assert wt.dropped == 6
+    assert [s["seq"] for s in wt.series()] == [6, 7, 8, 9]
+
+
+def test_worker_sample_interval_gated_and_delta_shipped():
+    wt = telemetry.WorkerTelemetry(1, interval_s=1.0)
+    wt.observe_latency(0.01)
+    first = wt.sample(10.0, {"resolved": 1})
+    assert first is not None and first["hist_count"] == 1
+    assert sum(first["hist"].values()) == 1
+    assert wt.sample(10.5, {"resolved": 1}) is None  # not due
+    wt.observe_latency(0.02)
+    wt.observe_latency(0.03)
+    second = wt.sample(11.5, {"resolved": 3})
+    # Only the NEW observations ship: the bucket delta since last snap.
+    assert second is not None and sum(second["hist"].values()) == 2
+    assert second["seq"] == 1
+    assert second["mono"] == 11.5 and isinstance(second["wall"], float)
+
+
+# -- BurnRateMonitor -------------------------------------------------------
+
+
+def test_burn_rate_windows_and_edge_trigger():
+    b = telemetry.BurnRateMonitor(slo_p99_s=0.1, goodput_frac=0.9,
+                                  short_window_s=1.0, long_window_s=4.0)
+    assert b.budget == pytest.approx(0.1)
+    assert b.is_bad(0.2) and not b.is_bad(0.05)
+    # Healthy traffic: burn well under 1 in both windows.
+    for k in range(8):
+        win = b.observe(k * 0.5, good=20, bad=0)
+        assert not win["alert_edge"]
+    assert b.alerts == 0
+    # Incident: all-bad intervals push BOTH windows over budget.
+    edges = 0
+    for k in range(8):
+        win = b.observe(4.0 + k * 0.5, good=0, bad=20)
+        edges += win["alert_edge"]
+    assert edges == 1  # edge-triggered: one crossing, not one per tick
+    assert b.alerts == 1
+    assert b.peak_short == pytest.approx(1.0 / 0.1)  # all-bad = 10x
+    # Recovery then a second incident: a second edge.
+    for k in range(16):
+        b.observe(8.0 + k * 0.5, good=20, bad=0)
+    for k in range(8):
+        b.observe(16.0 + k * 0.5, good=0, bad=20)
+    assert b.alerts == 2
+
+
+def test_burn_rate_short_window_trips_before_long():
+    b = telemetry.BurnRateMonitor(slo_p99_s=0.1, goodput_frac=0.9,
+                                  short_window_s=0.5, long_window_s=4.0)
+    for k in range(7):
+        b.observe(k * 0.5, good=40, bad=0)
+    win = b.observe(3.5, good=0, bad=20)
+    # One bad interval: the short window saturates (20 bad of 60 in
+    # window = 3.3x budget) but the long window dilutes it across the
+    # healthy history (20 of 300 = 0.67x) — no alert yet. Only a
+    # SUSTAINED incident trips both.
+    assert win["burn_short"] > 1.0
+    assert win["burn_long"] < win["burn_short"]
+    assert not win["alert_edge"]
+
+
+def test_burn_monitor_from_slo():
+    from mpi_and_open_mp_tpu.serve.loadgen import SLO
+
+    b = telemetry.BurnRateMonitor.from_slo(SLO(p99_s=0.3,
+                                               goodput_frac=0.8))
+    assert b.slo_p99_s == 0.3
+    assert b.budget == pytest.approx(0.2)
+
+
+# -- sidecar framing -------------------------------------------------------
+
+
+def _snap(worker, seq, **counters):
+    return {"v": telemetry.SNAPSHOT_SCHEMA, "worker": worker, "seq": seq,
+            "mono": 100.0 + seq, "wall": 1e9 + seq,
+            "counters": counters, "hist": {}, "hist_count": 0}
+
+
+def test_frame_roundtrip(tmp_path):
+    path = str(tmp_path / "w0.telemetry.bin")
+    with open(path, "ab") as fd:
+        for k in range(5):
+            telemetry.write_frame(fd, _snap(0, k, resolved=k))
+    rep = telemetry.read_frames(path)
+    assert rep["truncated"] == 0
+    assert [s["seq"] for s in rep["snapshots"]] == list(range(5))
+
+
+def test_frame_truncated_tail_soft_lands(tmp_path):
+    path = str(tmp_path / "w0.telemetry.bin")
+    with open(path, "ab") as fd:
+        for k in range(3):
+            telemetry.write_frame(fd, _snap(0, k))
+    blob = open(path, "rb").read()
+    # A kill -9 mid-write: chop the last frame in half.
+    open(path, "wb").write(blob[:-20])
+    rep = telemetry.read_frames(path)
+    assert [s["seq"] for s in rep["snapshots"]] == [0, 1]
+    assert rep["truncated"] == 1
+
+
+def test_frame_crc_corruption_stops_reader(tmp_path):
+    path = str(tmp_path / "w0.telemetry.bin")
+    with open(path, "ab") as fd:
+        for k in range(3):
+            telemetry.write_frame(fd, _snap(0, k))
+    blob = bytearray(open(path, "rb").read())
+    blob[12] ^= 0xFF  # flip a payload byte of frame 0
+    open(path, "wb").write(bytes(blob))
+    rep = telemetry.read_frames(path)
+    assert rep["snapshots"] == []  # reader stops at the first bad CRC
+    assert rep["truncated"] == 1
+
+
+def test_frame_reader_never_allocates_a_corrupt_length(tmp_path):
+    path = str(tmp_path / "w0.telemetry.bin")
+    open(path, "wb").write(struct.pack("<II", 1 << 30, 0) + b"x" * 64)
+    rep = telemetry.read_frames(path)
+    assert rep["snapshots"] == [] and rep["truncated"] == 1
+    assert telemetry.read_frames(str(tmp_path / "missing.bin")) == {
+        "snapshots": [], "truncated": 0, "bytes": 0}
+
+
+def test_clock_offset_median():
+    snaps = [dict(_snap(0, k), mono=100.0 + k, wall=500.0 + k)
+             for k in range(5)]
+    snaps[2]["wall"] += 3.0  # one jittered exchange: the median rejects it
+    assert telemetry.clock_offset(snaps) == pytest.approx(400.0)
+    assert telemetry.clock_offset([]) is None
+
+
+# -- FleetRollup -----------------------------------------------------------
+
+
+def test_rollup_merges_counters_and_detects_seq_gaps():
+    r = FleetRollup()
+    for seq in (0, 1, 3):  # seq 2 never arrives
+        assert r.ingest(_snap(0, seq, resolved=seq * 2))
+    assert r.ingest(_snap(1, 0, resolved=10))
+    assert r.counter("resolved") == 6 + 10  # latest per worker
+    loss = r.loss()
+    assert loss == {"expected": 5, "received": 4, "lost": 1,
+                    "truncated": 0, "frac": pytest.approx(0.2)}
+    r.truncated += 1  # a chopped sidecar frame charges loss too
+    assert r.loss() == {"expected": 6, "received": 4, "lost": 2,
+                        "truncated": 1, "frac": pytest.approx(2 / 6)}
+    r.truncated -= 1
+    assert not r.ingest({"v": 999, "worker": 0, "seq": 9})
+    assert r.rejected == 1
+
+
+def test_rollup_worker_key_override_isolates_lifetimes():
+    r = FleetRollup()
+    r.ingest(_snap(2, 0, resolved=5))
+    r.ingest(_snap(2, 1, resolved=8))
+    # A recovery worker re-uses index 2 but restarts seq at 0: under its
+    # own key that is a fresh series, not a gap.
+    r.ingest(_snap(2, 0, resolved=3), worker="2.rehome1")
+    loss = r.loss()
+    assert loss["lost"] == 0 and loss["expected"] == 3
+    assert r.counter("resolved") == 8 + 3
+    assert r.summary()["workers"] == [2, "2.rehome1"]
+
+
+def test_rollup_quantiles_from_shipped_deltas():
+    rng = np.random.default_rng(11)
+    r = FleetRollup()
+    exact = []
+    for w in range(3):
+        wt = telemetry.WorkerTelemetry(w, interval_s=0.01)
+        for i, v in enumerate(rng.exponential(0.05, size=200)):
+            wt.observe_latency(v)
+            exact.append(v)
+            if i % 50 == 49:
+                r.ingest(wt.sample(float(i), {}, force=True))
+    assert r.hist.count == len(exact)
+    for q in (50, 99):
+        assert r.hist.agrees(r.quantile(q), percentile(exact, q))
+
+
+# -- in-process fleet end-to-end ------------------------------------------
+
+
+def _run_fleet_burst(fleet, boards=24, steps=2):
+    rng = np.random.default_rng(5)
+    for k in range(boards):
+        fleet.submit((rng.random((32, 32)) < 0.3).astype(np.uint8), steps,
+                     session=f"s{k % 6}")
+    fleet.serve_until_drained(drain=True)
+
+
+def test_fleet_ships_snapshots_into_rollup_with_zero_loss(tmp_path):
+    fleet = Fleet(2, ServePolicy(max_batch=4, max_wait_s=0.0),
+                  heartbeat_interval_s=0.01, telemetry_interval_s=0.005)
+    _run_fleet_burst(fleet)
+    tel = fleet.router.telemetry
+    s = tel.summary()
+    assert s["snapshots"] > 0
+    assert s["loss"] == {"expected": s["loss"]["expected"],
+                         "received": s["loss"]["expected"], "lost": 0,
+                         "truncated": 0, "frac": 0.0}
+    assert s["resolved"] == 24
+    # The rollup's merged quantiles agree with the exact fleet-side
+    # percentiles within the declared bucket error.
+    lat = [t.latency_s for t in fleet.resolved_tickets()]
+    assert tel.hist.count == len(lat)
+    assert tel.hist.agrees(tel.quantile(50), percentile(lat, 50))
+    assert tel.hist.agrees(tel.quantile(99), percentile(lat, 99))
+    assert set(tel.clock_offsets()) == {0, 1}
+
+
+def test_fleet_telemetry_off_records_nothing():
+    fleet = Fleet(2, ServePolicy(max_batch=4, max_wait_s=0.0),
+                  heartbeat_interval_s=0.01, telemetry=False)
+    _run_fleet_burst(fleet, boards=8)
+    assert fleet.burn is None
+    assert fleet.router.telemetry.snapshots == 0
+    assert fleet.decisions == []
+
+
+def test_fleet_decisions_carry_burn_windows(tmp_path, monkeypatch):
+    sink = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("MOMP_TRACE", str(sink))
+    trace.reset()
+    try:
+        fleet = Fleet(
+            2, ServePolicy(max_batch=4, max_wait_s=0.0),
+            wal_dir=str(tmp_path / "wal"),
+            heartbeat_interval_s=0.01, telemetry_interval_s=0.005,
+            # A tight SLO every CPU batch breaches: the controller must
+            # ADD, and surplus is unreachable so it can never drain.
+            # breach_k=1 because a submitted-up-front burst drains in
+            # one pump round — there is only one elasticity tick.
+            elasticity=ElasticityPolicy(
+                slo_p99_s=1e-4, min_workers=1, max_workers=3,
+                breach_k=1, surplus_p99_frac=0.0))
+        _run_fleet_burst(fleet)
+        assert fleet.decisions, "breach never produced a decision"
+        for d in fleet.decisions:
+            assert d["action"] == "add"
+            for key in ("burn_short", "burn_long", "short_window_s",
+                        "long_window_s", "p99_s", "depth", "workers"):
+                assert key in d, (key, d)
+        assert len(fleet.handles) == 3  # capped by max_workers
+        # The decisions landed in the trace stream too, after a burn
+        # alert (the tick order: telemetry, then elasticity).
+        records = [json.loads(ln) for ln in
+                   sink.read_text().splitlines() if ln.strip()]
+        scales = [r for r in records if r.get("name") == "serve.fleet.scale"]
+        burns = [r for r in records if r.get("name") == "serve.fleet.burn"]
+        assert len(scales) == len(fleet.decisions)
+        assert burns, "SLO-breaching traffic never raised a burn alert"
+        assert burns[0]["ts"] <= scales[0]["ts"]
+        assert fleet.burn.summary()["burn_alerts"] >= 1
+    finally:
+        trace.reset()
+
+
+def test_shipper_writes_frames_and_final_flush(tmp_path):
+    path = str(tmp_path / "w.telemetry.bin")
+    resolved = []
+
+    def sample():
+        return {"resolved": len(resolved), "good": len(resolved),
+                "bad": 0}, [v for v in resolved[-2:]]
+
+    shipper = telemetry.SnapshotShipper(path, 7, sample, interval_s=0.01)
+    shipper.start()
+    for _ in range(3):
+        resolved.append(0.01)
+        time.sleep(0.03)
+    shipper.stop()
+    rep = telemetry.read_frames(path)
+    assert rep["truncated"] == 0
+    assert rep["snapshots"], "shipper never wrote a frame"
+    last = rep["snapshots"][-1]
+    assert last["counters"]["resolved"] == 3  # stop() force-ships
+    seqs = [s["seq"] for s in rep["snapshots"]]
+    assert seqs == list(range(len(seqs)))
+
+
+# -- merged fleet timeline (analysis/fleet_report.py) ----------------------
+
+
+def _write_trace(path, pid, names, base_ts=1000.0):
+    with open(path, "w") as fd:
+        for k, name in enumerate(names):
+            fd.write(json.dumps({
+                "kind": "span", "name": name, "ts": base_ts + k,
+                "dur": 0.5, "id": k + 1,
+                "parent": k if k else None,
+                "pid": pid, "host": "h"}) + "\n")
+
+
+def test_fleet_report_merges_tracks_with_id_namespacing(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "analysis"))
+    import fleet_report
+
+    d = tmp_path / "state"
+    d.mkdir()
+    # Two workers, COLLIDING span ids (each process counts from 1).
+    _write_trace(str(d / "worker0.trace.jsonl"), 100, ["a", "b"])
+    _write_trace(str(d / "worker1.trace.jsonl"), 200, ["a", "c"])
+    router = tmp_path / "router.trace.jsonl"
+    with open(router, "w") as fd:
+        fd.write(json.dumps({"kind": "event", "name": "serve.fleet.burn",
+                             "ts": 1500.0, "id": 1, "parent": None,
+                             "pid": 300, "host": "h"}) + "\n")
+        fd.write(json.dumps({"kind": "event", "name": "serve.fleet.scale",
+                             "ts": 1501.0, "id": 2, "parent": None,
+                             "pid": 300, "host": "h",
+                             "attrs": {"action": "add"}}) + "\n")
+    with open(d / "worker0.telemetry.bin", "ab") as fd:
+        for k in range(3):
+            telemetry.write_frame(fd, _snap(0, k, resolved=k, depth=1))
+
+    summary = fleet_report.fleet_report(
+        str(d), router_trace=str(router),
+        chrome_out=str(tmp_path / "merged.json"))
+    assert summary["tracks"] == ["router", "worker0", "worker1"]
+    assert summary["records"] == 6
+    assert summary["burn_events"] == 1
+    assert summary["burn_precedes_scale"] is True
+    assert summary["scale_events"][0]["action"] == "add"
+    assert summary["telemetry"]["loss"]["lost"] == 0
+    assert "0" in str(summary["clock_offsets"]) or summary["clock_offsets"]
+
+    chrome = json.loads((tmp_path / "merged.json").read_text())
+    evs = chrome["traceEvents"]
+    # Span ids remapped into per-source namespaces: no two X events from
+    # different pids share a span_id.
+    xs = [e for e in evs if e.get("ph") == "X"]
+    ids = [(e["args"]["span_id"], e["pid"]) for e in xs]
+    assert len({i for i, _ in ids}) == len(ids)
+    # Parent links survived the remap: worker0's child nests under its
+    # own root, in worker0's namespace.
+    by_pid = {}
+    for i, pid in ids:
+        by_pid.setdefault(pid, []).append(i)
+    for pid, pid_ids in by_pid.items():
+        assert max(pid_ids) - min(pid_ids) < fleet_report._ID_STRIDE
+    # Process tracks named after their source files.
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any("worker0" in n for n in names)
+    assert any("worker1" in n for n in names)
+    assert any("router" in n for n in names)
+    # Sidecar counters landed as Perfetto counter events on the wall
+    # axis via the clock offset.
+    counters = [e for e in evs if e.get("ph") == "C"]
+    assert any(e["name"] == "worker0.depth" for e in counters)
+
+
+def test_fleet_report_survives_killed_writer_tail(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "analysis"))
+    import fleet_report
+
+    d = tmp_path / "state"
+    d.mkdir()
+    _write_trace(str(d / "worker0.trace.jsonl"), 100, ["a"])
+    with open(d / "worker1.trace.jsonl", "w") as fd:
+        fd.write(json.dumps({"kind": "span", "name": "a", "ts": 1.0,
+                             "dur": 0.1, "id": 1, "parent": None,
+                             "pid": 200, "host": "h"}) + "\n")
+        fd.write('{"kind": "span", "name": "tr')  # killed mid-line
+    summary = fleet_report.fleet_report(str(d))
+    assert summary["records"] == 2  # the intact prefix still merges
+    assert summary["load_errors"]
+
+
+# -- satellite regressions -------------------------------------------------
+
+
+def test_trace_report_json_soft_lands_on_empty_and_header_only(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "analysis"))
+    import trace_report
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    header_only = tmp_path / "header.jsonl"
+    header_only.write_text('{"displayTimeUnit": "ms"}\n\n')
+    for path in (empty, header_only):
+        assert trace_report.main([str(path), "--json"]) == 0, path
+        assert trace_report.main([str(path)]) == 0  # text mode too
+        out = tmp_path / "chrome.json"
+        assert trace_report.main([str(path), "--chrome", str(out)]) == 0
+        chrome = json.loads(out.read_text())
+        assert chrome["traceEvents"] == []
+    from mpi_and_open_mp_tpu.obs import report
+
+    rep = report.report_dict(report.load(str(header_only)))
+    assert rep["records"] == 0
+    assert rep["phases"]["by_name"] == {}
+
+
+def test_metrics_label_cardinality_guard():
+    for k in range(300):
+        metrics.inc("sess.requests", session=f"s{k}")
+    snap = metrics.snapshot()
+    names = [key for key in snap["counters"] if key.startswith("sess.")]
+    assert len(names) == metrics.max_labelsets() == 256
+    assert metrics.get(metrics.DROPPED_LABELS) == 300 - 256
+    # Existing label sets keep updating under the cap.
+    metrics.inc("sess.requests", session="s0")
+    assert metrics.snapshot()["counters"]["sess.requests{session=s0}"] == 2
+    # Other stores share the guard; the overflow counter itself is
+    # label-free and can never be dropped.
+    for k in range(300):
+        metrics.gauge("sess.depth", k, session=f"s{k}")
+        metrics.observe("sess.lat", 0.1, session=f"s{k}")
+    snap = metrics.snapshot()
+    assert sum(1 for k in snap["gauges"] if k.startswith("sess.")) == 256
+    assert sum(1 for k in snap["histograms"] if k.startswith("sess.")) == 256
+    metrics.reset()
+    metrics.inc("sess.requests", session="s999")  # reset clears the cap
+    assert metrics.get("sess.requests", session="s999") == 1
+
+
+def test_metrics_labelset_cap_env_override(monkeypatch):
+    monkeypatch.setenv("MOMP_METRICS_MAX_LABELSETS", "4")
+    for k in range(10):
+        metrics.inc("m.x", label=f"v{k}")
+    assert len(metrics.snapshot()["counters"]) == 5  # 4 + dropped counter
+    assert metrics.get(metrics.DROPPED_LABELS) == 6
+    monkeypatch.setenv("MOMP_METRICS_MAX_LABELSETS", "bogus")
+    assert metrics.max_labelsets() == 256
+
+
+def test_metrics_delta_scopes_phases():
+    metrics.inc("phase.a", 5)
+    metrics.observe("lat", 0.1)
+    before = metrics.snapshot()
+    metrics.inc("phase.b", 3)
+    metrics.inc("phase.a", 2)
+    metrics.gauge("depth", 7)
+    metrics.observe("lat", 0.3)
+    d = metrics.delta(before, metrics.snapshot())
+    assert d["counters"] == {"phase.a": 2, "phase.b": 3}
+    assert d["gauges"] == {"depth": 7}
+    assert d["histograms"]["lat"]["count"] == 1
+    assert d["histograms"]["lat"]["total"] == pytest.approx(0.3)
+    # No movement -> empty delta, so a quiet phase reports nothing.
+    snap = metrics.snapshot()
+    assert metrics.delta(snap, snap) == {
+        "counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_sentinel_polarity_for_telemetry_fields():
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "analysis"))
+    import regression_sentinel as sentinel
+
+    assert sentinel.direction_for("telemetry_snapshot_loss_frac") == "lower"
+    assert sentinel.direction_for("loadgen_burn_rate_peak") == "lower"
+    assert "telemetry_snapshot_loss_frac" in sentinel.WATCH_FIELDS
+    assert "loadgen_burn_rate_peak" in sentinel.WATCH_FIELDS
+    # The rate rules still take precedence over the new keywords.
+    assert sentinel.direction_for("burnish_per_sec") == "higher"
