@@ -175,6 +175,20 @@ WATCH_FIELDS = (
     # same capacity number (lower by the ``burn`` rule).
     "telemetry_snapshot_loss_frac",
     "loadgen_burn_rate_peak",
+    # Wide-radius engine families (PR 20): per-family steady rates from
+    # the bench --radius-ab crossover sweep, recorded at the widest
+    # parity-clean radius measured (higher by the cups rule), plus the
+    # best family-vs-offset ratio over the radius >= 8 cells (higher by
+    # default — the ratio is same-process, RTT- and noise-cancelled
+    # like vs_heuristic). vs_offset_best sliding toward 1.0 means the
+    # restructured aggregation stopped beating the offset walk on the
+    # workload it exists for; the kill-switch flip (MOMP_ENGINE_FAMILY=
+    # offset left pinned) is caught by the ``engine_family`` provenance
+    # field, not a rate.
+    "radius_ab_offset_cups",
+    "radius_ab_sep_cups",
+    "radius_ab_fft_cups",
+    "radius_ab_vs_offset_best",
 )
 
 
@@ -207,7 +221,8 @@ PROVENANCE_FIELDS = ("impl", "batch_engine", "batch_pack_layout",
                      "attention_engine", "attention_hop_engine",
                      "attention_hop_engine_bwd", "sparse_engine",
                      "sharded_halo", "sparse_sharded_engine",
-                     "ring_hop_engine", "ring_hop_engine_bwd")
+                     "ring_hop_engine", "ring_hop_engine_bwd",
+                     "engine_family")
 
 #: ``workload`` joined in PR 13: a heat line and a life line of the same
 #: shape are different rules — they must never share a baseline group
@@ -240,11 +255,23 @@ def engine_rank(stamp) -> int:
     above every sequential tier: a ``sharded_halo`` flipping from
     ``overlap:deferred`` to ``seq:halo`` (the MOMP_HALO_OVERLAP=0 kill
     switch left on, or a geometry gate silently engaging) is a
-    provenance downgrade even when the rates are within noise."""
+    provenance downgrade even when the rates are within noise. The
+    engine-family stamps (PR 20) rank ``fft`` above ``sep`` above the
+    offset table: on the wide-radius workloads those families exist
+    for, an ``fft -> offset`` flip on the same configuration (the
+    MOMP_ENGINE_FAMILY=offset kill switch left pinned) is exactly the
+    silent O(r^2·n) regression this field exists to catch — ``offset``
+    itself falls through to the bottom tier. Matching is exact or
+    affixed (``fft``/``fft:*``/``*:fft``) so ``seq:halo`` never reads
+    as a ``sep`` stamp."""
     s = str(stamp or "")
     for prefix in ("batch:", "local:"):
         if s.startswith(prefix):
             s = s[len(prefix):]
+    if s == "fft" or s.startswith("fft:") or s.endswith(":fft"):
+        return 5
+    if s == "sep" or s.startswith("sep:") or s.endswith(":sep"):
+        return 4
     if s.startswith("sparse"):
         return 5
     if s.startswith("overlap:"):

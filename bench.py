@@ -1555,6 +1555,109 @@ def _sparse_sharded_ab_phase(args) -> dict:
     return fields
 
 
+def _radius_ab_phase(args) -> dict:
+    """The WIDE-RADIUS ENGINE-FAMILY A/B (``--radius-ab K``): K steps
+    of an ephemeral lenia spec at every ``--radius-list`` radius on a
+    ``--radius-board``² float32 board, racing the three aggregation
+    families (``stencils.engine.run_family``) — the O(r²·n) offset
+    walk, the rank-k separable row×col pass, the cached-rfft2 circular
+    convolution — wherever each family's legality gate admits the spec
+    and the ``MOMP_ENGINE_FAMILY`` pin allows it. Honesty discipline is
+    the headline's: every (radius, family) leg is oracle-parity-gated
+    first (8 steps, at the family's gate-owned tolerance —
+    ``parity_tol_for``), then warmed and chain-differenced (K vs 2K,
+    min-of-2 brackets; ``n`` is a runtime scalar so one executable
+    serves both). The table is the artifact — ``vs_offset`` per row is
+    the measured crossover — and the scalars the sentinel watches
+    (``radius_ab_*_cups``, ``radius_ab_vs_offset_best``) plus the
+    ``engine_family`` stamp (the winner at the widest radius; the
+    ledger keys on it, so a kill-switch run stamps ``offset`` and the
+    sentinel fails the downgrade) ride the line."""
+    from mpi_and_open_mp_tpu import stencils
+    from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+    from mpi_and_open_mp_tpu.utils.timing import anchor_sync
+
+    n_steps, edge = args.radius_ab, args.radius_board
+    radii = sorted({int(r) for r in str(args.radius_list).split(",")
+                    if r.strip()})
+    fields = {"radius_ab_board": edge, "radius_ab_steps": n_steps,
+              "radius_ab_radii": radii}
+    pin = stencil_engine.family_pinned()
+    if pin is not None:
+        fields["radius_ab_family_pin"] = pin
+    rows = []
+    rng = np.random.default_rng(46)
+    cells = edge * edge
+    best_at_widest = None  # (step_sec, family) at the widest radius
+    for radius in radii:
+        spec = stencils.make_lenia(radius, f"lenia_ab_r{radius}")
+        board = spec.init(rng, (edge, edge))
+        ref8 = stencils.oracle_run(spec, board, 8)
+        steps_by_family = {}
+        for fam in stencil_engine.ENGINE_FAMILIES:
+            if not stencil_engine.family_allowed(fam):
+                continue
+            if fam == "sep" and not stencil_engine.separable_supported(
+                    spec):
+                continue
+            if fam == "fft" and not stencil_engine.fft_supported(spec):
+                continue
+            row = {"radius": radius, "family": fam}
+            rows.append(row)
+            # Oracle gate at the family's gate-owned tolerance, before
+            # any number is recorded for this leg.
+            got = np.asarray(stencil_engine.run_family(
+                spec, board, 8, fam))
+            tol = stencil_engine.parity_tol_for(fam)
+            if not stencils.parity_ok(spec, got, ref8, **tol):
+                row["parity"] = False
+                continue
+            row["parity"] = True
+
+            def timed(n, fam=fam):
+                t0 = time.perf_counter()
+                anchor_sync(stencil_engine.run_family(
+                    spec, board, n, fam), fetch_all=True)
+                return time.perf_counter() - t0
+
+            timed(2 * n_steps)  # warm (n is runtime: one executable)
+            t1 = min(timed(n_steps) for _ in range(2))
+            t2 = min(timed(2 * n_steps) for _ in range(2))
+            diff = t2 > t1
+            step = (t2 - t1) / n_steps if diff else t1 / n_steps
+            steps_by_family[fam] = step
+            row.update({"cups": round(cells / step, 1),
+                        "is_differenced": diff})
+        off = steps_by_family.get("offset")
+        if off is not None:
+            for row in rows:
+                if (row["radius"] == radius and row["family"] != "offset"
+                        and row["family"] in steps_by_family):
+                    row["vs_offset"] = round(
+                        off / steps_by_family[row["family"]], 2)
+        if steps_by_family:
+            step, fam = min((s, f) for f, s in steps_by_family.items())
+            best_at_widest = (step, fam)
+            for f, s in steps_by_family.items():
+                fields[f"radius_ab_{f}_cups"] = round(cells / s, 1)
+    fields["radius_ab_table"] = rows
+    # The sentinel's headline watch scalar: the best measured speedup of
+    # a wide-radius family over the offset walk at radius >= 8. Absent
+    # (not 0) when no such leg ran — e.g. MOMP_ENGINE_FAMILY=offset —
+    # so the provenance downgrade, not a fake regression, is the signal.
+    vs = [row["vs_offset"] for row in rows
+          if row.get("vs_offset") is not None and row["radius"] >= 8]
+    if vs:
+        fields["radius_ab_vs_offset_best"] = max(vs)
+    crossed = [row["radius"] for row in rows
+               if row.get("vs_offset", 0) >= 1.0]
+    fields["radius_ab_crossover_radius"] = (
+        min(crossed) if crossed else None)
+    if best_at_widest is not None:
+        fields["engine_family"] = best_at_widest[1]
+    return fields
+
+
 def _autotune_phase(args, workload: str) -> dict:
     """The AUTOTUNE phase (``--autotune K``): install any persisted
     plans from the store first (validated + parity-gated), then either
@@ -1697,6 +1800,19 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
                            "ring_ab_error":
                            f"{type(e).__name__}: {e}"[:200]}
 
+    # The radius A/B is workload-generic (it sweeps its own ephemeral
+    # lenia specs): any headline may carry the crossover table.
+    radius_ab = {}
+    if args.radius_ab:
+        state["phase"] = "radius_ab"
+        with obs_trace.span("bench.phase", phase="radius_ab"):
+            try:
+                radius_ab = _radius_ab_phase(args)
+            except Exception as e:
+                radius_ab = {"radius_ab_board": args.radius_board,
+                             "radius_ab_error":
+                             f"{type(e).__name__}: {e}"[:200]}
+
     state["phase"] = "measure"
 
     def timed(n, reps=3):
@@ -1746,6 +1862,7 @@ def _stencil_bench(args, state, *, platform, device_kind, degraded,
         **tuned,
         **sharded_ab,
         **ring_ab,
+        **radius_ab,
         **metrics_fields,
         **backend_note,
     }
@@ -1769,7 +1886,8 @@ def main(argv=None) -> int:
                     "spec-engine headline (metric stencil_steady_cups_"
                     "<name>, same parity-gate + chained-differencing "
                     "discipline) and support --board/--steps/--trace/"
-                    "--ledger/--autotune/--sharded-ab only — the "
+                    "--ledger/--autotune/--sharded-ab/--radius-ab only "
+                    "— the "
                     "life-specific phases "
                     "(--batch/--serve/--sessions/--checkpoint-dir/"
                     "--sparse-ab) are rejected")
@@ -1838,6 +1956,28 @@ def main(argv=None) -> int:
     ap.add_argument("--sparse-tile", type=int, default=64, metavar="T",
                     help="active-tile size for the sparse A/B "
                     "(default 64)")
+    ap.add_argument("--radius-ab", type=int, default=0, metavar="K",
+                    help="also run the WIDE-RADIUS ENGINE-FAMILY A/B "
+                    "(any workload): K steps of an ephemeral lenia spec "
+                    "per --radius-list radius on a --radius-board² "
+                    "float32 board, racing the offset-table walk vs the "
+                    "separable row×col pass vs the cached-rfft2 "
+                    "circular convolution (stencils.engine.run_family) "
+                    "wherever each family's legality gate admits it, "
+                    "every leg oracle-parity-gated at its gate-owned "
+                    "tolerance and chain-differenced, reporting the "
+                    "radius_ab_table crossover rows plus "
+                    "radius_ab_{offset,sep,fft}_cups / "
+                    "radius_ab_vs_offset_best and the engine_family "
+                    "stamp on the JSON line (runs on every backend; "
+                    "MOMP_ENGINE_FAMILY=offset pins the walk, which "
+                    "the sentinel fails as a provenance downgrade)")
+    ap.add_argument("--radius-board", type=int, default=128, metavar="N",
+                    help="board edge for the radius A/B "
+                    "(default %(default)s)")
+    ap.add_argument("--radius-list", default="1,4,8,16", metavar="R1,R2,..",
+                    help="comma list of kernel radii the radius A/B "
+                    "sweeps (default %(default)s)")
     ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                     help="run the checkpointed robustness phase, writing "
                     "Orbax restart points here")
@@ -1991,6 +2131,22 @@ def main(argv=None) -> int:
     if args.ring_ab and args.ring_ab < 16:
         ap.error("--ring-ab needs >= 16 calls for the "
                  "chained-differencing bracket")
+    if args.radius_ab:
+        if args.radius_ab < 16:
+            ap.error("--radius-ab needs >= 16 steps for the "
+                     "chained-differencing bracket")
+        try:
+            radii = [int(r) for r in str(args.radius_list).split(",")
+                     if r.strip()]
+        except ValueError:
+            ap.error(f"--radius-list wants a comma list of radii, "
+                     f"got {args.radius_list!r}")
+        if not radii or any(r < 1 for r in radii):
+            ap.error(f"--radius-list radii must be positive, "
+                     f"got {args.radius_list!r}")
+        if args.radius_board < 4 * max(radii):
+            ap.error(f"--radius-board {args.radius_board} is too small "
+                     f"for radius {max(radii)} (needs >= 4*radius)")
     if args.sparse_ab or args.sparse_sharded_ab:
         if args.sparse_ab and args.sparse_ab < 16:
             ap.error("--sparse-ab needs >= 16 steps for the "
@@ -2393,6 +2549,20 @@ def _bench(args, state) -> int:
                     "sparse_sharded_error":
                     f"{type(e).__name__}: {e}"[:200]}
 
+    # Wide-radius engine-family A/B (opt-in via --radius-ab K): the
+    # offset/sep/fft crossover sweep. Same failure contract as the
+    # other opt-in phases.
+    radius_ab = {}
+    if args.radius_ab:
+        state["phase"] = "radius_ab"
+        with obs_trace.span("bench.phase", phase="radius_ab"):
+            try:
+                radius_ab = _radius_ab_phase(args)
+            except Exception as e:
+                radius_ab = {"radius_ab_board": args.radius_board,
+                             "radius_ab_error":
+                             f"{type(e).__name__}: {e}"[:200]}
+
     # Secondary: the SHARDED flagship entry point (row-layout bitfused
     # over a 1-device mesh — all the bench chip has). Since the 1-device
     # serial dispatch, this measures what a user of the sharded API gets
@@ -2682,6 +2852,7 @@ def _bench(args, state) -> int:
         **sharded_ab,
         **ring_ab,
         **sparse_sharded,
+        **radius_ab,
         **sharded,
         **prof_fields,
         **trace_fields,

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# r08 queued increment (ISSUE 20, DESIGN.md §22): the wide-radius
+# engine-family race on the real chip. The CPU mesh already showed the
+# offset table dethroned from radius 4 up (sep ~7x, fft ~19x at radius
+# 8 on 128²); the chip decides where ITS crossover sits — the MXU/VPU
+# balance, HBM-resident rfft2 plans, and the fused offset ladder's
+# chained dispatch all move it, so the sweep runs the full radius
+# ladder {1,4,8,16} at a board big enough that the widest kernel still
+# has 4x headroom. Every family row is oracle-parity-gated BEFORE it
+# is timed (sep/fft at the gate-owned float tolerances, offset
+# bit-default) and chain-differenced (K vs 2K dispatch) so the ~70 ms
+# relay RTT cancels. Every line lands in MOMP_LEDGER (exported by
+# tpu_queue_loop.sh) with the engine_family provenance stamp, so a
+# later run whose race silently collapses to the offset table (e.g.
+# MOMP_ENGINE_FAMILY=offset left exported) flags at the queue loop's
+# sentinel gate as a provenance downgrade, not a throughput blip. One
+# chip process per bench run, sequential; exits nonzero on failure so
+# the loop requeues it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+# The headline sweep: radius ladder at the acceptance geometry. The
+# line must record radius_ab_crossover_radius (min radius where a
+# non-offset family posts vs_offset >= 1.0) and stamp engine_family
+# with the widest radius' winner.
+python bench.py --board 500 --steps 500 --radius-ab 64 \
+    --radius-board 256 --radius-list 1,4,8,16
+
+# Wider board twin: FFT cost scales n·log n while the offset ladder
+# scales r²·n, so the crossover can only move DOWN with board size —
+# if it moves up, something (plan cache, padding, layout) regressed.
+python bench.py --board 500 --steps 500 --radius-ab 64 \
+    --radius-board 512 --radius-list 4,8,16
+
+# Tuner drill: the families must enter the per-shape race and the
+# winner must persist + reload through the plan store under the same
+# fingerprint the daemon consults, with the sparse fuse-depth axis
+# enumerated alongside (heuristic depth-16 clamp always candidate #0).
+python - <<'PYEOF'
+from mpi_and_open_mp_tpu.tune import runner, space
+
+report = runner.tune("lenia", (2, 64, 64), steps=64)
+timed = {m["path"] for m in report["measurements"]}
+assert {"stencil:sep", "stencil:fft"} & timed, (
+    f"no wide-radius family entered the race: {sorted(timed)}")
+assert report["vs_heuristic"] >= 1.0, report["vs_heuristic"]
+print(f"lenia tune: winner {report['tuned']['path']} "
+      f"at {report['vs_heuristic']}x heuristic")
+
+fuse = space.sparse_fuse_depths(1, space.SPARSE_SHARDED_TILE)
+assert fuse[0] == min(space.SPARSE_FUSE_HEURISTIC,
+                      space.SPARSE_SHARDED_TILE), fuse
+assert len(fuse) > 1, "fuse axis enumerated only the heuristic"
+print(f"sparse fuse axis: {fuse}")
+PYEOF

@@ -64,10 +64,14 @@ ENV = "MOMP_LEDGER"
 #: sharded): the active-tile engine stamp for whichever sparse phase the
 #: line ran ({sparse-sharded:*, sparse:*, dense:*}) — the sentinel
 #: treats sparse-sharded -> dense:sharded (MOMP_SPARSE_SHARDED=0 left
-#: on) as a provenance downgrade.
+#: on) as a provenance downgrade. ``engine_family`` joined in PR 20
+#: (wide-radius engine families): the aggregation family the line's
+#: stencil phase ran ({offset, sep, fft}) — the sentinel treats
+#: fft/sep -> offset on the same workload (MOMP_ENGINE_FAMILY=offset
+#: left pinned) as a provenance downgrade.
 KEY_FIELDS = ("metric", "topology", "shape", "dtype", "steps", "batch",
               "batch_pack_layout", "resident", "workload", "plan",
-              "halo", "sparse", "engine")
+              "halo", "sparse", "engine_family", "engine")
 
 _GIT_SHA: str | None = None
 
@@ -142,6 +146,9 @@ def stamp(record: dict, *, source: str = "bench.py",
         # (it is the composed engine this key exists to pin).
         "sparse": record.get("sparse_sharded_engine",
                              record.get("sparse_engine", "-")),
+        # "-" for lines without a stencil engine-family phase; family
+        # lines carry the closed vocabulary {offset, sep, fft}.
+        "engine_family": record.get("engine_family", "-"),
         "engine": record.get("impl", "?"),
     }
     return {
@@ -194,7 +201,7 @@ def load(path: str) -> list[dict]:
 #: keep matching new lines that carry the explicit "-" placeholder.
 _KEY_DEFAULTS = {"batch_pack_layout": "-", "resident": "-",
                  "workload": "life", "plan": "-", "halo": "-",
-                 "sparse": "-"}
+                 "sparse": "-", "engine_family": "-"}
 
 
 def config_key(entry: dict, fields: tuple[str, ...] = KEY_FIELDS) -> str:

@@ -98,10 +98,20 @@ def install_planned_path(workload: str, shape, path: str) -> None:
 
 def planned_path(workload: str, shape) -> str | None:
     """The installed tuned path for (workload, stack shape), or ``None``
-    when no plan is installed or ``MOMP_TUNE=0`` pins tuning off."""
+    when no plan is installed or ``MOMP_TUNE=0`` pins tuning off. An
+    installed ``stencil:sep``/``stencil:fft`` plan whose family the
+    ``MOMP_ENGINE_FAMILY`` pin disallows is neutralized the same way —
+    the pin takes effect at the NEXT dispatch, no uninstall needed."""
     if not _tune_enabled():
         return None
-    return _PLANNED_PATHS.get(_plan_key(workload, shape))
+    path = _PLANNED_PATHS.get(_plan_key(workload, shape))
+    if path is not None and path.startswith("stencil:"):
+        from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
+
+        if not stencil_engine.family_allowed(
+                stencil_engine.family_for_path(path)):
+            return None
+    return path
 
 
 def clear_planned_paths() -> None:

@@ -699,20 +699,7 @@ class ServingDaemon:
         if spec is not None and spec.name != "life":
             from mpi_and_open_mp_tpu import stencils
 
-            def stencil_roll(guarded: bool):
-                def run():
-                    import jax.numpy as jnp
-
-                    if guarded and chaos.take_serve_fault():
-                        raise RuntimeError(
-                            "chaos: injected serve dispatch fault")
-                    with (contextlib.nullcontext() if guarded
-                          else chaos.suppressed()):
-                        return np.asarray(stencils.run_roll_batch(
-                            spec, jnp.asarray(stack), steps))
-                return run
-
-            def stencil_pallas(guarded: bool):
+            def stencil_rung(runner, guarded: bool):
                 def run():
                     import jax.numpy as jnp
 
@@ -722,8 +709,7 @@ class ServingDaemon:
                     with (contextlib.nullcontext() if guarded
                           else chaos.suppressed()):
                         return np.asarray(
-                            stencils.run_padded_pallas_batch(
-                                spec, jnp.asarray(stack), steps))
+                            runner(jnp.asarray(stack), steps))
                 return run
 
             def stencil_oracle():
@@ -733,27 +719,38 @@ class ServingDaemon:
                         out[b] = stencils.oracle_run(spec, out[b], steps)
                     return out
 
-            # The per-spec Pallas padded kernel is a REAL rung for
-            # single-channel specs: primary when an installed tuned plan
-            # picked it, else the guarded fallback under the roll engine
-            # (so the tuner's candidate is exactly what serving runs).
-            pallas_ok = stencils.pallas_batch_supported(spec, stack.shape)
+            # Every legal engine for this spec, ladder order: the roll
+            # engine leads, then the Pallas padded kernel
+            # (single-channel specs), then the PR 20 engine families
+            # where their legality gates + the MOMP_ENGINE_FAMILY pin
+            # allow. An installed tuned plan promotes ITS rung to the
+            # front (so the tuner's winner is exactly what serving
+            # runs); the front rung is the guarded primary, the rest
+            # are chaos-suppressed fallbacks, the oracle closes.
+            avail = [(f"batch:stencil:{spec.name}", "stencil:roll",
+                      lambda s, n: stencils.run_roll_batch(spec, s, n))]
+            if stencils.pallas_batch_supported(spec, stack.shape):
+                avail.append(
+                    (f"batch:stencil-pallas:{spec.name}",
+                     "stencil:pallas",
+                     lambda s, n: stencils.run_padded_pallas_batch(
+                         spec, s, n)))
+            if (stencils.separable_supported(spec)
+                    and stencils.family_allowed("sep")):
+                avail.append(
+                    (f"batch:stencil-sep:{spec.name}", "stencil:sep",
+                     lambda s, n: stencils.run_family_batch(
+                         spec, s, n, "sep")))
+            if (stencils.fft_supported(spec)
+                    and stencils.family_allowed("fft")):
+                avail.append(
+                    (f"batch:stencil-fft:{spec.name}", "stencil:fft",
+                     lambda s, n: stencils.run_family_batch(
+                         spec, s, n, "fft")))
             planned = pallas_life.planned_path(spec.name, stack.shape)
-            if pallas_ok and planned == "stencil:pallas":
-                rungs = [
-                    (f"batch:stencil-pallas:{spec.name}",
-                     stencil_pallas(True)),
-                    (f"batch:stencil:{spec.name}", stencil_roll(False)),
-                ]
-            elif pallas_ok:
-                rungs = [
-                    (f"batch:stencil:{spec.name}", stencil_roll(True)),
-                    (f"batch:stencil-pallas:{spec.name}",
-                     stencil_pallas(False)),
-                ]
-            else:
-                rungs = [(f"batch:stencil:{spec.name}",
-                          stencil_roll(True))]
+            avail.sort(key=lambda e: e[1] != planned)
+            rungs = [(name, stencil_rung(runner, i == 0))
+                     for i, (name, _, runner) in enumerate(avail)]
             return rungs + [("oracle", stencil_oracle)]
 
         on_tpu = jax.default_backend() == "tpu"

@@ -8,25 +8,40 @@ sharded halo exchange — global tile mask, cross-shard activation).
 """
 
 from .engine import (  # noqa: F401
+    ENGINE_FAMILIES,
+    FFT_MIN_RADIUS,
     aggregate_roll,
+    family_allowed,
+    family_for_path,
+    family_pinned,
+    fft_supported,
     offsets,
     oracle_run,
     pallas_batch_supported,
     parity_ok,
+    parity_tol_for,
+    run_family,
+    run_family_batch,
     run_padded_pallas_batch,
     run_roll,
     run_roll_batch,
+    separable_supported,
+    step_fft,
     step_numpy,
     step_padded,
+    step_padded_family,
     step_roll,
+    step_sep,
 )
 from .spec import (  # noqa: F401
     GRAY_SCOTT,
     HEAT,
+    LENIA,
     LIFE,
     WIREWORLD,
     StencilSpec,
     get,
+    make_lenia,
     names,
     register,
 )

@@ -23,10 +23,14 @@ Registered workloads (``get(name)`` / ``names()``):
 * ``heat`` — float32 5-point diffusion (explicit Euler, alpha=0.1).
 * ``gray_scott`` — two-channel float32 reaction-diffusion.
 * ``wireworld`` — 4-state automaton (empty/head/tail/conductor).
+* ``lenia`` — wide-radius (r=8) float32 smooth-growth automaton; its
+  Gaussian ring kernel is exactly rank-2 factorizable, the workload the
+  separable/FFT engine families (PR 20) exist for.
 """
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -36,6 +40,36 @@ import numpy as np
 BOX3 = ((1, 1, 1), (1, 0, 1), (1, 1, 1))
 #: Radius-1 5-point cross (von Neumann), center zero.
 CROSS3 = ((0, 1, 0), (1, 0, 1), (0, 1, 0))
+
+#: Singular values below ``s_max * _SEP_RANK_CUTOFF`` are factorization
+#: noise, not rank — the residual past the kept rank must be exactly
+#: this kind of float64 dust for a table to count as factorizable.
+_SEP_RANK_CUTOFF = 1e-12
+
+
+@functools.lru_cache(maxsize=None)
+def _separable_factors(weights: tuple, radius: int):
+    """Low-rank row x col factorization of a weight table, or None.
+
+    Returns ``((u_0, v_0), ..., (u_{k-1}, v_{k-1}))`` float64 vectors
+    with ``w == sum_k outer(u_k, v_k)`` to float64-SVD exactness, where
+    ``k`` is the table's numerical rank. A table only factors when
+    ``k <= radius`` — past that the row+col pass count ``2*k*(2r+1)``
+    stops beating the ``(2r+1)^2 - 1`` offset walk, and the zero-center
+    constraint means every table is at least rank 2 (a rank-1 outer
+    product with a zero center needs a zero row or column), so no
+    radius-1 table ever factors. Cached per (weights, radius): legality
+    gates hit this through ``StencilSpec.separable_rank`` without
+    re-running the SVD.
+    """
+    w = np.asarray(weights, np.float64)
+    u, s, vt = np.linalg.svd(w)
+    if s[0] == 0.0:
+        return None
+    rank = int((s > s[0] * _SEP_RANK_CUTOFF).sum())
+    if rank > radius:
+        return None
+    return tuple((u[:, k] * s[k], vt[k, :]) for k in range(rank))
 
 
 @dataclass(frozen=True)
@@ -76,6 +110,16 @@ class StencilSpec:
     @property
     def is_float(self) -> bool:
         return np.issubdtype(self.np_dtype, np.floating)
+
+    @functools.cached_property
+    def separable_rank(self) -> int | None:
+        """Numerical rank of the weight table when it factors into
+        ``rank`` row x col passes (``rank <= radius``), else None.
+        Cached on the instance (``cached_property`` writes through
+        ``__dict__``, so frozen is fine) — legality gates read this
+        per call without re-factorizing."""
+        f = _separable_factors(self.weights, self.radius)
+        return None if f is None else len(f)
 
     def board_shape(self, ny: int, nx: int) -> tuple:
         """Full board shape for an ``ny x nx`` grid (channels leading)."""
@@ -123,6 +167,23 @@ def _gray_scott_update(center, agg, xp):
     un = u + (GS_DU * lu - uvv + GS_F * (1 - u)) * GS_DT
     vn = v + (GS_DV * lv + uvv - (GS_F + GS_K) * v) * GS_DT
     return xp.stack([un, vn]).astype(center.dtype)
+
+
+#: Lenia growth-bell parameters. Weights are normalized to sum 1, so the
+#: aggregate is a weighted mean in [0, 1]; the growth map then peaks at
+#: LENIA_MU with width LENIA_SIGMA. SIGMA and DT are chosen so one
+#: step's error amplification ``1 + DT * max|g'|`` stays ~1.5 — an
+#: 8-step parity window amplifies float noise ~25x, which the family
+#: parity tolerances (engine.parity_tol_for) are sized against.
+LENIA_MU, LENIA_SIGMA, LENIA_DT = 0.35, 0.25, 0.1
+
+
+def _lenia_update(center, agg, xp):
+    # Smooth growth: Gaussian bell mapped to [-1, 1], explicit Euler,
+    # state clipped to the unit interval.
+    g = 2.0 * xp.exp(
+        -((agg - LENIA_MU) ** 2) / (2.0 * LENIA_SIGMA ** 2)) - 1.0
+    return xp.clip(center + LENIA_DT * g, 0.0, 1.0).astype(center.dtype)
 
 
 def _wireworld_pre(board, xp):
@@ -180,6 +241,31 @@ def _wireworld_init(rng, shape):
         p=[0.55, 0.05, 0.05, 0.35]).astype(np.uint8)
 
 
+def _lenia_init(rng, shape):
+    ny, nx = shape
+    return rng.random((ny, nx)).astype(np.float32)
+
+
+def make_lenia(radius: int, name: str | None = None) -> StencilSpec:
+    """Wide-radius smooth automaton at any radius (bench sweeps use
+    ephemeral specs; only radius 8 is registered as ``"lenia"``).
+
+    The kernel is a normalized Gaussian ring ``outer(g, g)`` with the
+    center zeroed — an even-rank (exactly rank-2) table at any radius
+    >= 2, so the separable family factors it exactly while the offset
+    walk pays the full ``(2r+1)^2 - 1`` gathers.
+    """
+    side = 2 * radius + 1
+    g = np.exp(-0.5 * ((np.arange(side) - radius) / (0.35 * radius)) ** 2)
+    w = np.outer(g, g)
+    w[radius, radius] = 0.0
+    w /= w.sum()
+    weights = tuple(tuple(float(x) for x in row) for row in w)
+    return StencilSpec(
+        name=name or f"lenia_r{radius}", radius=radius, dtype="float32",
+        weights=weights, update=_lenia_update, init=_lenia_init)
+
+
 def _life_oracle(board):
     from mpi_and_open_mp_tpu.ops import life_ops
 
@@ -193,6 +279,12 @@ _REGISTRY: dict[str, StencilSpec] = {}
 
 
 def register(spec: StencilSpec) -> StencilSpec:
+    """Validate + register. Integer 0/1 tables and float tables (any
+    value, any rank — even-rank factorizable Gaussian rings included)
+    are both fine; the only hard constraints are the square shape, the
+    zero center, and finiteness. ``separable_rank`` is warmed here so
+    every later legality gate is a cached attribute read, never an SVD.
+    """
     if spec.name in _REGISTRY:
         raise ValueError(f"stencil {spec.name!r} already registered")
     side = 2 * spec.radius + 1
@@ -205,6 +297,10 @@ def register(spec: StencilSpec) -> StencilSpec:
         raise ValueError(
             f"stencil {spec.name!r}: weights center must be 0 (the rule "
             "sees the center via the `center` argument)")
+    if not np.isfinite(w.astype(np.float64)).all():
+        raise ValueError(
+            f"stencil {spec.name!r}: weights must be finite")
+    spec.separable_rank
     _REGISTRY[spec.name] = spec
     return spec
 
@@ -239,3 +335,5 @@ WIREWORLD = register(StencilSpec(
     name="wireworld", radius=1, dtype="uint8", weights=BOX3,
     update=_wireworld_update, pre=_wireworld_pre, states=4,
     init=_wireworld_init))
+
+LENIA = register(make_lenia(8, "lenia"))

@@ -229,12 +229,14 @@ class PlanStore:
                 self._note("stale", path=path, quarantined=q or "",
                            error=f"fingerprint drift: {drift}"[:200])
                 continue
-            if engine.startswith("sharded:"):
+            if engine.startswith(("sharded:", "sparse_sharded:")):
                 # Sharded-schedule records (tune.runner.tune_sharded):
                 # no batched engine to pin — the choice is an
                 # (axis_order, halo schedule) pair the sharded runner
                 # consults via lookup_sharded(). Parity-gated through
-                # the sharded runner itself on the record's own mesh.
+                # the sharded runner itself on the record's own mesh
+                # (sparse_sharded winners rebuild a fresh engine at the
+                # persisted tile + fuse depth instead).
                 if parity_gate and not self._sharded_parity_ok(
                         record, path):
                     summary["parity_rejected"] += 1
@@ -282,13 +284,25 @@ class PlanStore:
             board = spec.init(np.random.default_rng(_PARITY_SEED),
                               (ny, nx))
             fuse = int(choice.get("fuse_steps", 1))
-            out = stencil_engine.run_sharded(
-                spec, board, PARITY_STEPS, mesh=mesh,
-                layout=str(choice["axis_order"]),
-                fuse_steps=fuse,
-                boundary_steps=int(choice.get("boundary_steps", fuse)),
-                overlap=(None if choice.get("halo_overlap") == "overlap"
-                         else False))
+            if str(choice["path"]).startswith("sparse_sharded:"):
+                from mpi_and_open_mp_tpu.stencils import sparse_sharded
+
+                eng = sparse_sharded.SparseShardedEngine(
+                    spec, board, mesh=mesh,
+                    layout=str(choice["axis_order"]),
+                    tile=int(choice["tile"]), fuse=fuse)
+                eng.step(PARITY_STEPS)
+                out = eng.snapshot()
+            else:
+                out = stencil_engine.run_sharded(
+                    spec, board, PARITY_STEPS, mesh=mesh,
+                    layout=str(choice["axis_order"]),
+                    fuse_steps=fuse,
+                    boundary_steps=int(choice.get("boundary_steps",
+                                                  fuse)),
+                    overlap=(None
+                             if choice.get("halo_overlap") == "overlap"
+                             else False))
             ok = stencils.parity_ok(
                 spec, np.asarray(out),
                 stencils.oracle_run(spec, board, PARITY_STEPS))
@@ -345,10 +359,16 @@ class PlanStore:
                     run = space.runner_for(workload, str(choice["path"]))
                     got = np.asarray(run(jnp.asarray(stack),
                                          jnp.int32(PARITY_STEPS)))
+            # The gate owns each engine family's float tolerance —
+            # stencil:sep / stencil:fft records gate at their family's
+            # slack, everything else at the default.
+            tol = stencils.parity_tol_for(
+                stencils.family_for_path(str(choice["path"])))
             ok = got.shape == stack.shape and all(
                 stencils.parity_ok(
                     spec, got[i],
-                    stencils.oracle_run(spec, stack[i], PARITY_STEPS))
+                    stencils.oracle_run(spec, stack[i], PARITY_STEPS),
+                    **tol)
                 for i in range(b))
         except Exception as e:  # noqa: BLE001 — a broken engine is a
             # rejection, never a crash: the heuristics keep serving.

@@ -78,8 +78,13 @@ def tune(workload: str, shape, *, steps: int = 64, store=None,
             try:
                 run = space.runner_for(workload, cand.path)
                 got = np.asarray(run(stack_j, jnp.int32(parity_steps)))
+                # The parity GATE owns each family's float tolerance
+                # (offset keeps the default; sep/fft get their
+                # amplification-sized slack from parity_tol_for).
+                tol = stencils.parity_tol_for(
+                    stencils.family_for_path(cand.path))
                 ok = got.shape == stack.shape and all(
-                    stencils.parity_ok(spec, got[i], want[i])
+                    stencils.parity_ok(spec, got[i], want[i], **tol)
                     for i in range(b))
             except Exception as e:  # noqa: BLE001 — a candidate that
                 # cannot dispatch is a rejection, never a crash
@@ -237,10 +242,10 @@ def tune_sharded(workload: str, shape, *, mesh=None, steps: int = 32,
                     from mpi_and_open_mp_tpu.stencils import (
                         sparse_sharded)
 
-                    def bench_once(n):
+                    def bench_once(n, fuse=cand.fuse_steps):
                         eng = sparse_sharded.SparseShardedEngine(
                             spec, board, mesh=mesh, layout=layout,
-                            tile=space.SPARSE_SHARDED_TILE)
+                            tile=space.SPARSE_SHARDED_TILE, fuse=fuse)
                         anchor_sync(eng.step(int(n)))
                         return eng
 
@@ -357,6 +362,11 @@ def tune_sharded(workload: str, shape, *, mesh=None, steps: int = 32,
                 "fuse_steps": best["fuse_steps"],
                 "boundary_steps": best["boundary_steps"],
                 "mesh_axes": [py, px],
+                # Sparse winners re-run through a fresh engine at
+                # install parity; the tile rides along so the rebuild
+                # is exactly the profiled geometry.
+                **({"tile": space.SPARSE_SHARDED_TILE}
+                   if best["path"].startswith("sparse_sharded:") else {}),
             },
             "heuristic": heur,
             "tuned": best,

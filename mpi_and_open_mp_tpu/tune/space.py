@@ -69,6 +69,35 @@ class Candidate:
 #: sparse-vs-dense decision itself, which is what the race measures).
 SPARSE_SHARDED_TILE = 64
 
+#: The sparse-sharded engine's static fuse depth — the ctor default it
+#: shipped with. PR 20 promotes fuse to an enumerated axis; this rung
+#: (clamped by legality like the ctor clamps it) is always candidate
+#: #0 of the sparse slate so ``vs_heuristic`` stays >= 1.0.
+SPARSE_FUSE_HEURISTIC = 16
+
+
+def sparse_fuse_depths(radius: int, tile: int) -> tuple[int, ...]:
+    """Legal sparse-sharded fuse depths, heuristic rung FIRST. Legality
+    is the ctor's clamp: ``radius * fuse <= tile`` (a deeper fuse would
+    read past one tile's halo ring). The heuristic depth 16 is clamped
+    the same way the engine clamps it, so the first rung is exactly
+    what an untuned ctor runs; ``MOMP_TUNE_SPARSE_FUSE`` (comma list,
+    default "4,16,64") adds the measured rungs — wide-radius specs,
+    where the clamp bites hardest, are exactly why this axis exists."""
+    import os
+
+    cap = max(1, int(tile) // max(1, int(radius)))
+    heur = min(SPARSE_FUSE_HEURISTIC, cap)
+    raw = os.environ.get("MOMP_TUNE_SPARSE_FUSE", "4,16,64")
+    out = [heur]
+    for tok in raw.split(","):
+        if not tok.strip():
+            continue
+        f = max(1, int(tok))
+        if f <= cap and f not in out:
+            out.append(f)
+    return tuple(out)
+
 
 def sharded_fuse_depths() -> tuple[int, ...]:
     """Interior fuse depths the sharded space enumerates.
@@ -180,11 +209,17 @@ def sharded_candidates(workload: str, shape: tuple[int, int],
                 layout, (py, px), shard, spec.radius,
                 SPARSE_SHARDED_TILE)
             if sp.enabled:
-                out.append(Candidate(
-                    workload=str(workload),
-                    path=f"sparse_sharded:{layout}",
-                    pack_layout="-", bucket_rounding=BUCKET_POW2,
-                    axis_order=layout, halo_overlap="sparse"))
+                # Fuse is an enumerated axis (PR 20): the clamped
+                # ctor-default depth leads so the untuned engine is
+                # always candidate #0 of the sparse slate.
+                for f in sparse_fuse_depths(spec.radius,
+                                            SPARSE_SHARDED_TILE):
+                    out.append(Candidate(
+                        workload=str(workload),
+                        path=f"sparse_sharded:{layout}",
+                        pack_layout="-", bucket_rounding=BUCKET_POW2,
+                        axis_order=layout, halo_overlap="sparse",
+                        fuse_steps=f))
     return out
 
 
@@ -218,12 +253,23 @@ def stencil_paths(spec, shape: tuple[int, int, int]) -> list[str]:
     """Legal batched engine paths for a non-life stencil spec: the
     vmapped roll engine always, plus the per-spec Pallas padded kernel
     when the spec supports a batch axis (single-channel only — see
-    ``stencils.engine.pallas_batch_supported``)."""
+    ``stencils.engine.pallas_batch_supported``), plus the PR 20 engine
+    families where their legality gates pass — separable needs a
+    factorizable table (``separable_supported``: rank <= radius, which
+    no radius-1 zero-center table satisfies, so narrow specs enumerate
+    exactly as before), FFT needs a float dtype and radius >=
+    ``FFT_MIN_RADIUS``. Both respect the ``MOMP_ENGINE_FAMILY`` pin."""
     from mpi_and_open_mp_tpu.stencils import engine as stencil_engine
 
     paths = ["stencil:roll"]
     if stencil_engine.pallas_batch_supported(spec, shape):
         paths.append("stencil:pallas")
+    if (stencil_engine.separable_supported(spec)
+            and stencil_engine.family_allowed("sep")):
+        paths.append("stencil:sep")
+    if (stencil_engine.fft_supported(spec)
+            and stencil_engine.family_allowed("fft")):
+        paths.append("stencil:fft")
     return paths
 
 
@@ -313,5 +359,9 @@ def runner_for(workload: str, path: str):
     if path == "stencil:pallas":
         return lambda s, n: stencil_engine.run_padded_pallas_batch(
             spec, s, n)
+    if path in ("stencil:sep", "stencil:fft"):
+        family = stencil_engine.family_for_path(path)
+        return lambda s, n: stencil_engine.run_family_batch(
+            spec, s, n, family)
     raise ValueError(f"unknown stencil engine path {path!r} "
                      f"for workload {workload!r}")

@@ -63,7 +63,7 @@ def test_stamp_schema_and_config_key():
         "shape": "500x500", "dtype": "uint8", "steps": 10_000,
         "batch": 0, "batch_pack_layout": "-", "resident": "-",
         "workload": "life", "plan": "-", "halo": "-", "sparse": "-",
-        "engine": "pallas",
+        "engine_family": "-", "engine": "pallas",
     }
     # Full key renders in canonical order; any subset stays stable.
     full = ledger.config_key(e)

